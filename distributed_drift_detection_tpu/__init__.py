@@ -1,0 +1,36 @@
+"""TPU-native distributed concept-drift-detection framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of
+``rcorizzo/distributed-drift-detection`` (Spark + sklearn + skmultiflow; see
+SURVEY.md): DDM drift detection with a paired train/predict/detect/retrain
+microbatch loop, data-parallel over row-striped stream partitions — as a
+jit-compiled streaming kernel vmapped over partitions and sharded over a
+``jax.sharding.Mesh`` instead of a Spark cluster.
+"""
+
+from .config import DDMParams, RunConfig, replace
+from .ops import DDMState, ddm_batch, ddm_init, ddm_scan, ddm_step
+
+__version__ = "0.1.0"
+
+
+def run(cfg, stream=None):
+    """Execute one drift-detection run (lazy import to keep `import
+    distributed_drift_detection_tpu` light)."""
+    from .api import run as _run
+
+    return _run(cfg, stream)
+
+
+__all__ = [
+    "DDMParams",
+    "RunConfig",
+    "replace",
+    "DDMState",
+    "ddm_batch",
+    "ddm_init",
+    "ddm_scan",
+    "ddm_step",
+    "run",
+    "__version__",
+]

@@ -1,0 +1,132 @@
+"""Top-level API: configure → run → metrics, with a backend seam.
+
+This is the framework equivalent of executing ``DDM_Process.py`` end to end
+(SURVEY.md §3.1), preserved as a function: load + synthesize the stream (C2),
+stripe it over partitions (C8), run the compiled detection loop on the
+selected backend, merge flags and compute the delay metric (C10), append a
+results row (C11).
+
+The ``backend=`` seam mirrors the north-star plugin boundary:
+
+* ``'jax'`` — the TPU-native path: jit + vmap over partitions, sharded over a
+  ``Mesh`` when more than one device is visible.
+* ``'spark'`` — interface-identical stub for the reference's execution model;
+  always raises ``NotImplementedError`` (with install guidance when PySpark
+  is absent) — the Spark path is deliberately not reimplemented.
+
+The timed span matches the reference's ``Final Time``
+(``DDM_Process.py:224→:260``): device upload + compiled loop + flag
+collection + delay computation — not just the kernel.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from .config import RunConfig
+from .engine.loop import FlagRows
+from .io.stream import StreamData, load_stream, stripe_partitions
+from .metrics import DelayMetrics, delay_metrics, result_row
+from .models import ModelSpec, build_model
+from .parallel.mesh import make_mesh, make_mesh_runner, shard_batches
+from .results import append_result
+from .utils.timing import PhaseTimer
+
+
+class PreparedRun(NamedTuple):
+    """Everything needed to execute a configured run (shared by api + bench)."""
+
+    stream: StreamData
+    batches: object  # engine.Batches, partition-major
+    runner: object  # jitted (batches, keys) -> MeshRunResult
+    keys: jax.Array
+    mesh: object  # jax.sharding.Mesh | None
+
+
+def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
+    """Load, stripe and compile-build a run without executing it."""
+    if stream is None:
+        stream = load_stream(
+            cfg.dataset, cfg.mult_data, seed=cfg.seed, standardize=cfg.standardize
+        )
+    batches = stripe_partitions(stream, cfg.partitions, cfg.per_batch)
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model(cfg.model, spec, cfg)
+    n_dev = cfg.mesh_devices or len(jax.devices())
+    n_dev = min(n_dev, len(jax.devices()))
+    # The mesh size must divide the partition count; fall back toward fewer
+    # devices (the reference likewise ran any instance count on whatever
+    # cluster existed).
+    while n_dev > 1 and cfg.partitions % n_dev:
+        n_dev -= 1
+    mesh = make_mesh(n_dev) if n_dev > 1 else None
+    runner = make_mesh_runner(model, cfg.ddm, mesh, shuffle=cfg.shuffle_batches)
+    keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
+    return PreparedRun(stream, batches, runner, keys, mesh)
+
+
+class RunResult(NamedTuple):
+    flags: FlagRows  # numpy leaves [P, NB-1]
+    drift_vote: np.ndarray  # [NB-1]
+    metrics: DelayMetrics
+    total_time: float  # the reference's "Final Time" span
+    timings: dict  # per-phase breakdown (aux subsystem: tracing)
+    stream: StreamData
+    config: RunConfig
+
+
+def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
+    if cfg.backend == "spark":
+        return _run_spark(cfg)
+    if cfg.backend != "jax":
+        raise ValueError(f"unknown backend {cfg.backend!r}; expected 'jax' or 'spark'")
+    return _run_jax(cfg, stream)
+
+
+def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
+    timer = PhaseTimer()
+
+    with timer.phase("prepare"):
+        prep = prepare(cfg, stream)
+    stream, batches, runner, keys, mesh = prep
+
+    # --- the reference's Final Time span starts here (:224) ---
+    start = time.perf_counter()
+    with timer.phase("upload"):
+        dev_batches, dev_keys = shard_batches(batches, keys, mesh)
+    with timer.phase("detect"):
+        out = runner(dev_batches, dev_keys)
+        jax.block_until_ready(out)
+    with timer.phase("collect"):
+        flags = jax.tree.map(np.asarray, out.flags)
+        vote = np.asarray(out.drift_vote)
+        m = delay_metrics(
+            flags.change_global, stream.dist_between_changes, cfg.per_batch
+        )
+    total_time = time.perf_counter() - start
+    # --- span ends (:260) ---
+
+    if cfg.results_csv:
+        append_result(cfg.results_csv, result_row(cfg, total_time, m, stream.num_rows))
+
+    return RunResult(flags, vote, m, total_time, timer.as_dict(), stream, cfg)
+
+
+def _run_spark(cfg: RunConfig):
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "backend='spark' preserves the reference's execution-model seam "
+            "(SURVEY.md §7 layer 6) but PySpark is not installed in this "
+            "environment. Use backend='jax' — it accepts the same RunConfig "
+            "and produces the same results schema."
+        ) from e
+    raise NotImplementedError(
+        "The Spark execution path is intentionally not reimplemented; "
+        "this framework's native path is backend='jax'."
+    )

@@ -1,0 +1,9 @@
+from .loop import Batches, FlagRows, LoopCarry, make_partition_runner, make_partition_step
+
+__all__ = [
+    "Batches",
+    "FlagRows",
+    "LoopCarry",
+    "make_partition_runner",
+    "make_partition_step",
+]

@@ -1,0 +1,9 @@
+from .stream import StreamData, load_csv, load_stream, stripe_partitions, synthesize_stream
+
+__all__ = [
+    "StreamData",
+    "load_csv",
+    "load_stream",
+    "stripe_partitions",
+    "synthesize_stream",
+]

@@ -1,0 +1,141 @@
+"""Stream loading, synthesis and partitioning (reference C2 + C8 data path).
+
+``load_stream`` + ``synthesize_stream`` reproduce the reference's stream
+construction (``DDM_Process.py:38-55``): load a CSV of numeric features plus a
+``target`` column; scale volume by ``mult_data`` (fraction-sample when < 1,
+duplicate ×N + shuffle otherwise); sort by ``target`` so each class label is
+one planted "concept"; derive ``dist_between_changes = rows // classes``.
+
+Deliberate deviations (SURVEY.md quirk register):
+
+* Shuffles are seeded (the reference's ``sample(frac=1)`` at ``:49`` is not).
+* Feature count is inferred from the file (quirk #5 — ``NUMBER_OF_FEATURES``).
+* Global row ids are **positions in the sorted stream** (0..N-1). The
+  reference stamps ``full_df_row_number = df.index`` *after* sorting
+  (``:220``), i.e. pre-sort CSV row ids — an artifact that makes its delay
+  metric (``changes % dist_between_changes``, ``:253-256``) meaningless for
+  ``mult_data > 1``. Positional ids keep the metric exact at every scale
+  while matching it exactly at ``mult_data = 1`` (where the CSV is already
+  target-sorted).
+
+``stripe_partitions`` reproduces the reference's placement (C8, ``:225-226``):
+row *i* of the stream goes to partition ``i % P`` — every partition sees a
+1/P-thinned copy of the same stream with the same concept boundaries — then
+pads each partition to a rectangular ``[P, NB, B]`` microbatch grid with a
+validity plane (TPU arrays are rectangular; the reference's last ragged batch
+becomes masked padding).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from ..engine.loop import Batches
+
+
+class StreamData(NamedTuple):
+    """A prepared drift stream (host-side, numpy)."""
+
+    X: np.ndarray  # [N, F] f32
+    y: np.ndarray  # [N] i32, labels re-indexed to 0..C-1
+    num_classes: int
+    dist_between_changes: int  # rows // classes (C2, :55)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.y)
+
+    @property
+    def num_features(self) -> int:
+        return self.X.shape[1]
+
+
+def load_csv(path: str, target_column: str = "target") -> tuple[np.ndarray, np.ndarray]:
+    """Load a numeric CSV with a named target column (no pandas needed)."""
+    with open(path) as fh:
+        header = fh.readline().strip().split(",")
+    tcol = header.index(target_column)
+    raw = np.loadtxt(path, delimiter=",", skiprows=1, dtype=np.float32)
+    mask = np.ones(len(header), bool)
+    mask[tcol] = False
+    return raw[:, mask], raw[:, tcol].astype(np.int64)
+
+
+def synthesize_stream(
+    X: np.ndarray,
+    y: np.ndarray,
+    mult_data: float = 1.0,
+    seed: int = 0,
+    standardize: bool = True,
+) -> StreamData:
+    """Volume-scale, shuffle, sort-by-target — the C2 semantics, seeded."""
+    rng = np.random.default_rng(seed)
+    n = len(y)
+    if mult_data < 1.0:
+        take = rng.permutation(n)[: max(1, int(round(n * mult_data)))]
+        X, y = X[take], y[take]
+    else:
+        reps = int(mult_data)
+        idx = rng.permutation(n * reps) % n
+        X, y = X[idx], y[idx]
+
+    order = np.argsort(y, kind="stable")  # :51, stable like pandas sort_values
+    X, y = X[order], y[order]
+
+    classes, y_idx = np.unique(y, return_inverse=True)
+    if standardize:
+        mu = X.mean(axis=0)
+        sd = X.std(axis=0)
+        X = (X - mu) / np.where(sd > 0, sd, 1.0)
+
+    return StreamData(
+        X=np.ascontiguousarray(X, np.float32),
+        y=y_idx.astype(np.int32),
+        num_classes=len(classes),
+        dist_between_changes=len(y) // len(classes),
+    )
+
+
+def load_stream(
+    path: str, mult_data: float = 1.0, seed: int = 0, standardize: bool = True
+) -> StreamData:
+    X, y = load_csv(path)
+    return synthesize_stream(X, y, mult_data, seed, standardize)
+
+
+def stripe_partitions(stream: StreamData, partitions: int, per_batch: int) -> Batches:
+    """Row-stripe the stream over P partitions and slice into microbatches.
+
+    Returns :class:`Batches` with leading partition axis: ``X [P, NB, B, F]``,
+    ``y/rows/valid [P, NB, B]``. ``rows`` holds global stream positions so the
+    delay metric (global position % concept length) works per the reference's
+    intent.
+    """
+    n, f = stream.X.shape
+    p, b = partitions, per_batch
+    per_part = -(-n // p)  # ceil: partition sizes differ by ≤ 1 (C8)
+    nb = -(-per_part // b)
+    padded = p * nb * b
+
+    def pad(arr, fill):
+        out = np.full((padded, *arr.shape[1:]), fill, arr.dtype)
+        out[:n] = arr
+        return out
+
+    rows = np.arange(padded, dtype=np.int32)
+    valid = rows < n
+
+    def stripe(arr):
+        # position i → partition i % P, slot i // P  (C8 :225)
+        return np.ascontiguousarray(
+            arr.reshape(nb * b, p, *arr.shape[1:]).swapaxes(0, 1)
+        ).reshape(p, nb, b, *arr.shape[1:])
+
+    return Batches(
+        X=stripe(pad(stream.X, 0.0)),
+        y=stripe(pad(stream.y, 0)),
+        rows=stripe(rows),
+        valid=stripe(valid),
+    )

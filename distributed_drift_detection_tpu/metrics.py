@@ -1,0 +1,82 @@
+"""Detection-delay metrics and run records (reference C10/C11/L6).
+
+The reference computes, per detected change, ``change_position %
+dist_between_changes`` (``calc_change_dist``, ``DDM_Process.py:253-256``) —
+valid because planted concepts are equal-length — then drops −1 sentinel rows
+(``:259``) and appends the mean plus the run configuration to a results CSV
+(``:265-273``). Reproduced here over the gathered flag tables, plus
+throughput fields the reference lacks (records/sec, the BASELINE.json
+metric).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DelayMetrics:
+    num_detections: int
+    mean_delay_rows: float  # mean(change_global % dist_between_changes)
+    mean_delay_batches: float
+    detections_per_partition: np.ndarray  # [P] i32
+    delays: np.ndarray  # all individual delays (rows)
+
+
+def delay_metrics(
+    change_global: np.ndarray, dist_between_changes: int, per_batch: int
+) -> DelayMetrics:
+    """Compute delay stats from a ``[P, NB-1]`` change-position table."""
+    change_global = np.asarray(change_global)
+    detected = change_global >= 0
+    positions = change_global[detected]
+    delays = positions % dist_between_changes
+    mean_rows = float(delays.mean()) if len(delays) else float("nan")
+    return DelayMetrics(
+        num_detections=int(detected.sum()),
+        mean_delay_rows=mean_rows,
+        mean_delay_batches=mean_rows / per_batch if len(delays) else float("nan"),
+        detections_per_partition=detected.sum(axis=-1).astype(np.int32),
+        delays=delays,
+    )
+
+
+# Reference C11 column schema (``DDM_Process.py:272``), kept verbatim so the
+# notebook-style aggregation (C13-C15) ports unchanged; extended with
+# throughput columns. "Spark Address" carries the backend string here.
+RESULT_COLUMNS = [
+    "Spark App",
+    "Exp Start Time",
+    "Spark Address",
+    "Instances",
+    "Data Multiplier",
+    "Memory",
+    "Cores",
+    "Final Time",
+    "Average Distance",
+    "Rows",
+    "Rows Per Sec",
+    "Detections",
+]
+
+
+def result_row(
+    cfg: Any, total_time: float, metrics: DelayMetrics, num_rows: int
+) -> list:
+    return [
+        cfg.resolved_app_name(),
+        cfg.time_string,
+        cfg.url,
+        cfg.partitions,
+        float(cfg.mult_data),
+        cfg.memory,
+        cfg.cores,
+        total_time,
+        metrics.mean_delay_rows,
+        num_rows,
+        num_rows / total_time if total_time > 0 else float("nan"),
+        metrics.num_detections,
+    ]

@@ -1,0 +1,11 @@
+from .base import Model, ModelSpec
+from .classifiers import build_model, make_linear, make_majority, make_mlp
+
+__all__ = [
+    "Model",
+    "ModelSpec",
+    "build_model",
+    "make_linear",
+    "make_majority",
+    "make_mlp",
+]

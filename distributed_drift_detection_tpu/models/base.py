@@ -1,0 +1,40 @@
+"""Model interface for the drift-detection loop.
+
+The reference's classifier contract (C4/C5, ``DDM_Process.py:96-128``) is:
+fit a *fresh* model on microbatch *a*, predict labels on microbatch *b*, emit
+per-row error indicators. Its ``RandomForestClassifier`` is hostile to TPUs
+(dynamic trees, host threads), so models here are **pure parameter pytrees**
+with jit-able ``fit``/``predict`` — "retrain on drift" becomes a
+``jnp.where``-select of freshly fitted params inside the compiled loop, with
+zero recompilation and static shapes.
+
+A model is a :class:`Model` record of three pure functions:
+
+  * ``init(key) -> params`` — params with final shapes (for the scan carry).
+  * ``fit(key, X, y, w) -> params`` — fresh fit on one microbatch;
+    ``w`` is a {0,1} row-validity weight (padding rows contribute nothing).
+  * ``predict(params, X) -> preds`` — int32 class predictions.
+
+All shapes are static: ``X [B, F]``, ``y [B]``, ``w [B]``; the class count is
+baked in at construction (inferred from the dataset — SURVEY.md quirk #5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+
+
+class Model(NamedTuple):
+    name: str
+    init: Callable[[jax.Array], Any]
+    fit: Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Any]
+    predict: Callable[[Any, jax.Array], jax.Array]
+
+
+class ModelSpec(NamedTuple):
+    """Static problem geometry every model is built against."""
+
+    num_features: int
+    num_classes: int

@@ -1,0 +1,190 @@
+"""TPU-native classifiers for the train/predict/detect loop.
+
+Three families, all pure pytrees (see ``base.py`` for the contract):
+
+* ``majority`` — predicts the modal class of the training microbatch. The
+  cheapest model and a faithful proxy for what the reference's RandomForest
+  (``DDM_Process.py:96-105``) does on the sorted stream, where most training
+  batches are single-class: it predicts that class until the concept changes.
+  Also the model used for *exact* golden tests of the loop, since it is
+  deterministic and shared bit-for-bit with the NumPy oracle.
+* ``linear`` — multinomial logistic regression (softmax), fitted with K
+  full-batch gradient steps. One ``[B,F]×[F,C]`` matmul per step — MXU food.
+* ``mlp`` — MLP with configurable hidden widths (default (128, 64), the
+  BASELINE.json "Per-partition MLP(128,64)" config), fitted with K SGD +
+  momentum steps.
+
+Fits run inside ``lax.scan``/``vmap``, so they must be cheap, fixed-shape,
+and key-driven. Class count is static (inferred from the dataset).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Model, ModelSpec
+
+
+# --------------------------------------------------------------------------
+# majority-class
+# --------------------------------------------------------------------------
+
+
+def make_majority(spec: ModelSpec) -> Model:
+    c = spec.num_classes
+
+    def init(key):
+        return jnp.int32(0)
+
+    def fit(key, X, y, w):
+        counts = jnp.zeros(c, jnp.float32).at[y].add(w)
+        # argmax ties resolve to the lowest class, matching np.unique order
+        # in the oracle.
+        return jnp.argmax(counts).astype(jnp.int32)
+
+    def predict(params, X):
+        return jnp.full(X.shape[0], params, jnp.int32)
+
+    return Model("majority", init, fit, predict)
+
+
+# --------------------------------------------------------------------------
+# linear (multinomial logistic regression)
+# --------------------------------------------------------------------------
+
+
+class LinearParams(NamedTuple):
+    w: jax.Array  # [F, C]
+    b: jax.Array  # [C]
+
+
+def _softmax_ce_grads(params: LinearParams, X, onehot, wn):
+    logits = X @ params.w + params.b
+    probs = jax.nn.softmax(logits, axis=-1)
+    g = (probs - onehot) * wn[:, None]  # [B, C]
+    return LinearParams(X.T @ g, jnp.sum(g, axis=0))
+
+
+def make_linear(spec: ModelSpec, *, fit_steps: int = 32, learning_rate: float = 0.5) -> Model:
+    f, c = spec.num_features, spec.num_classes
+
+    def init(key):
+        return LinearParams(jnp.zeros((f, c), jnp.float32), jnp.zeros(c, jnp.float32))
+
+    def fit(key, X, y, w):
+        onehot = jax.nn.one_hot(y, c, dtype=jnp.float32)
+        wn = w / jnp.maximum(jnp.sum(w), 1.0)
+
+        def step(params, _):
+            grads = _softmax_ce_grads(params, X, onehot, wn)
+            return (
+                LinearParams(
+                    params.w - learning_rate * grads.w,
+                    params.b - learning_rate * grads.b,
+                ),
+                None,
+            )
+
+        params, _ = lax.scan(step, init(key), None, length=fit_steps)
+        return params
+
+    def predict(params, X):
+        return jnp.argmax(X @ params.w + params.b, axis=-1).astype(jnp.int32)
+
+    return Model("linear", init, fit, predict)
+
+
+# --------------------------------------------------------------------------
+# MLP
+# --------------------------------------------------------------------------
+
+
+class MLPLayer(NamedTuple):
+    w: jax.Array
+    b: jax.Array
+
+
+def make_mlp(
+    spec: ModelSpec,
+    *,
+    hidden: tuple[int, ...] = (128, 64),
+    fit_steps: int = 32,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+) -> Model:
+    dims = (spec.num_features, *hidden, spec.num_classes)
+
+    def init(key):
+        keys = jax.random.split(key, len(dims) - 1)
+        layers = []
+        for k, din, dout in zip(keys, dims[:-1], dims[1:]):
+            scale = jnp.sqrt(2.0 / din)
+            layers.append(
+                MLPLayer(
+                    scale * jax.random.normal(k, (din, dout), jnp.float32),
+                    jnp.zeros(dout, jnp.float32),
+                )
+            )
+        return tuple(layers)
+
+    def forward(params, X):
+        h = X
+        for layer in params[:-1]:
+            h = jax.nn.relu(h @ layer.w + layer.b)
+        last = params[-1]
+        return h @ last.w + last.b
+
+    def loss_fn(params, X, onehot, wn):
+        logits = forward(params, X)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(wn * jnp.sum(onehot * logp, axis=-1))
+
+    grad_fn = jax.grad(loss_fn)
+
+    def fit(key, X, y, w):
+        onehot = jax.nn.one_hot(y, spec.num_classes, dtype=jnp.float32)
+        wn = w / jnp.maximum(jnp.sum(w), 1.0)
+        params0 = init(key)
+        vel0 = jax.tree.map(jnp.zeros_like, params0)
+
+        def step(carry, _):
+            params, vel = carry
+            grads = grad_fn(params, X, onehot, wn)
+            vel = jax.tree.map(lambda v, g: momentum * v - learning_rate * g, vel, grads)
+            params = jax.tree.map(lambda p, v: p + v, params, vel)
+            return (params, vel), None
+
+        (params, _), _ = lax.scan(step, (params0, vel0), None, length=fit_steps)
+        return params
+
+    def predict(params, X):
+        return jnp.argmax(forward(params, X), axis=-1).astype(jnp.int32)
+
+    return Model("mlp", init, fit, predict)
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+
+def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
+    """Build a model by config name (``RunConfig.model``)."""
+    kw = {}
+    if cfg is not None:
+        kw = dict(fit_steps=cfg.fit_steps)
+    if name == "majority":
+        return make_majority(spec)
+    if name == "linear":
+        lr = cfg.learning_rate if cfg is not None else 0.5
+        return make_linear(spec, learning_rate=lr, **kw)
+    if name == "mlp":
+        hidden = tuple(cfg.mlp_hidden) if cfg is not None else (128, 64)
+        lr = cfg.mlp_learning_rate if cfg is not None else 0.05
+        return make_mlp(spec, hidden=hidden, learning_rate=lr, **kw)
+    raise ValueError(f"unknown model {name!r}; expected majority|linear|mlp")

@@ -1,0 +1,17 @@
+from .ddm import (
+    DDMBatchResult,
+    DDMState,
+    ddm_batch,
+    ddm_init,
+    ddm_scan,
+    ddm_step,
+)
+
+__all__ = [
+    "DDMBatchResult",
+    "DDMState",
+    "ddm_batch",
+    "ddm_init",
+    "ddm_scan",
+    "ddm_step",
+]

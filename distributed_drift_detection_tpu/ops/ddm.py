@@ -1,0 +1,204 @@
+"""DDM (Drift Detection Method, Gama et al. 2004) as pure JAX kernels.
+
+The reference delegates this statistic to ``skmultiflow.drift_detection.DDM``
+(``DDM_Process.py:133,139``) and feeds it one error indicator at a time from a
+Python ``iterrows()`` loop (``DDM_Process.py:144-152``) — the scalar hot loop
+identified in SURVEY.md §3.2. Here it becomes two TPU-native kernels:
+
+* :func:`ddm_step` — the per-element recurrence as a ``(carry, err) ->
+  (carry, flags)`` function (scan-able; kept as the executable spec).
+* :func:`ddm_batch` — the same semantics over a whole microbatch with **no
+  sequential dependency**: the running error mean is a ``cumsum``, and the
+  running minimum of ``p+s`` (with its ``(p_min, s_min)`` payload) is an
+  associative combine, so the per-batch detector runs as a handful of
+  vectorised O(B) primitives instead of B Python iterations. This is what
+  makes the detector essentially free on the MXU-adjacent VPU and lets
+  throughput come from ``vmap`` over partitions.
+
+Semantics reproduced exactly (spec: SURVEY.md §3.3; behaviour of
+``skmultiflow.DDM`` as constructed at ``DDM_Process.py:139``):
+
+  with sample index i (1-based since the last reset),
+
+    p_i = mean(err_1..err_i)            # incremental form p += (err-p)/i
+    s_i = sqrt(p_i * (1 - p_i) / i)
+    after the update, the sample counter is i+1; the min/warn/change section
+    runs only when  i + 1 >= min_num_instances;
+    if p_i + s_i <= (p+s)_min:  (p+s)_min, p_min, s_min ← p_i + s_i, p_i, s_i
+      (ties update — a later equal minimum wins)
+    change  when p_i + s_i > p_min + out_control_level * s_min
+    warning when p_i + s_i > p_min + warning_level    * s_min  (and not change)
+
+The detector is *reset by the caller* on change (the reference sets
+``ddm = None`` at ``DDM_Process.py:209``; skmultiflow's lazy self-reset on the
+next ``add_element`` is therefore never observed and is not reproduced).
+
+Numerical note: state carries ``(count, err_sum)`` rather than ``p``, so the
+scalar and batch paths compute identical expressions; f32 is exact for error
+sums below 2^24 elements between resets, far beyond any realistic run between
+drifts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import DDMParams
+
+_INF = jnp.inf
+
+
+class DDMState(NamedTuple):
+    """Carried detector state. All leaves are scalars (vmap adds axes)."""
+
+    count: jax.Array  # i32: elements absorbed since last reset
+    err_sum: jax.Array  # f32: sum of error indicators since last reset
+    ps_min: jax.Array  # f32: running min of p+s (inf until first update)
+    p_min: jax.Array  # f32: p at the running min
+    s_min: jax.Array  # f32: s at the running min
+
+
+class DDMBatchResult(NamedTuple):
+    """Per-microbatch detection summary (−1 sentinels, reference C6)."""
+
+    first_warning: jax.Array  # i32: index in batch of first warning, or −1
+    first_change: jax.Array  # i32: index in batch of first change, or −1
+
+
+def ddm_init() -> DDMState:
+    """Fresh detector state (equivalent to a new skmultiflow ``DDM``)."""
+    f = jnp.float32
+    return DDMState(
+        count=jnp.int32(0),
+        err_sum=f(0.0),
+        ps_min=f(_INF),
+        p_min=f(_INF),
+        s_min=f(_INF),
+    )
+
+
+def ddm_step(
+    state: DDMState, err: jax.Array, params: DDMParams = DDMParams()
+) -> tuple[DDMState, tuple[jax.Array, jax.Array]]:
+    """One ``add_element`` (executable spec; see module docstring).
+
+    Args:
+      state: carried :class:`DDMState`.
+      err: scalar error indicator in {0, 1} (f32).
+      params: detector thresholds.
+
+    Returns:
+      ``(new_state, (warning, change))`` with boolean flags.
+    """
+    cnt = state.count + 1
+    esum = state.err_sum + err
+    cnt_f = cnt.astype(jnp.float32)
+    p = esum / cnt_f
+    s = jnp.sqrt(jnp.clip(p * (1.0 - p), 0.0) / cnt_f)
+    ps = p + s
+
+    check = (cnt + 1) >= params.min_num_instances
+    take = check & (ps <= state.ps_min)
+    ps_min = jnp.where(take, ps, state.ps_min)
+    p_min = jnp.where(take, p, state.p_min)
+    s_min = jnp.where(take, s, state.s_min)
+
+    change = check & (ps > p_min + params.out_control_level * s_min)
+    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
+
+    new_state = DDMState(cnt, esum, ps_min, p_min, s_min)
+    return new_state, (warning, change)
+
+
+def ddm_scan(
+    state: DDMState, errs: jax.Array, params: DDMParams = DDMParams()
+) -> tuple[DDMState, tuple[jax.Array, jax.Array]]:
+    """Sequential reference path: ``lax.scan`` of :func:`ddm_step` over errs."""
+
+    def body(carry, err):
+        return ddm_step(carry, err, params)
+
+    return lax.scan(body, state, errs)
+
+
+def _run_min(ps_masked: jax.Array, p: jax.Array, s: jax.Array):
+    """Running (min of ps, payload p, payload s), later elements win ties."""
+
+    def combine(a, b):  # a earlier, b later
+        take_b = b[0] <= a[0]
+        return tuple(jnp.where(take_b, bb, aa) for aa, bb in zip(a, b))
+
+    return lax.associative_scan(combine, (ps_masked, p, s))
+
+
+def ddm_batch(
+    state: DDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: DDMParams = DDMParams(),
+) -> tuple[DDMState, DDMBatchResult]:
+    """Vectorised microbatch update — semantics of the reference's per-row
+    loop + first-warning/first-change/early-break protocol
+    (``DDM_Process.py:141-152``), in O(B) parallel primitives.
+
+    Elements after the first change are ignored (the reference ``break``s at
+    ``:152``); on change the caller is expected to reset the state (the
+    reference discards the detector at ``:209``), so the returned state is only
+    meaningful when ``first_change == -1``.
+
+    Args:
+      state: carried :class:`DDMState`.
+      errs: ``[B]`` f32 error indicators.
+      valid: ``[B]`` bool mask (False = padding row; contributes nothing).
+      params: detector thresholds.
+
+    Returns:
+      ``(state_after_full_batch, DDMBatchResult)``.
+    """
+    b = errs.shape[0]
+    v = valid.astype(jnp.int32)
+    cnt = state.count + jnp.cumsum(v)  # i32 [B]
+    esum = state.err_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
+    cnt_f = jnp.maximum(cnt, 1).astype(jnp.float32)
+    p = esum / cnt_f
+    s = jnp.sqrt(jnp.clip(p * (1.0 - p), 0.0) / cnt_f)
+    ps = p + s
+
+    check = valid & ((cnt + 1) >= params.min_num_instances)
+    ps_masked = jnp.where(check, ps, _INF)
+    run_ps, run_p, run_s = _run_min(ps_masked, p, s)
+
+    # Merge the carried minima (strictly earlier than every batch element, so
+    # a batch minimum that ties it wins — same `<=` rule).
+    use_run = run_ps <= state.ps_min
+    ps_min = jnp.where(use_run, run_ps, state.ps_min)
+    p_min = jnp.where(use_run, run_p, state.p_min)
+    s_min = jnp.where(use_run, run_s, state.s_min)
+
+    change = check & (ps > p_min + params.out_control_level * s_min)
+    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
+
+    idx = jnp.arange(b, dtype=jnp.int32)
+    has_change = jnp.any(change)
+    cpos = jnp.argmax(change).astype(jnp.int32)  # first True (0 if none)
+    first_change = jnp.where(has_change, cpos, jnp.int32(-1))
+
+    # Warnings at positions the reference loop never reached don't count.
+    limit = jnp.where(has_change, cpos, jnp.int32(b))
+    warning_seen = warning & (idx <= limit)
+    has_warn = jnp.any(warning_seen)
+    wpos = jnp.argmax(warning_seen).astype(jnp.int32)
+    first_warning = jnp.where(has_warn, wpos, jnp.int32(-1))
+
+    new_state = DDMState(
+        count=cnt[-1],
+        err_sum=esum[-1],
+        ps_min=ps_min[-1],
+        p_min=p_min[-1],
+        s_min=s_min[-1],
+    )
+    return new_state, DDMBatchResult(first_warning, first_change)

@@ -1,0 +1,15 @@
+from .mesh import (
+    PARTITION_AXIS,
+    MeshRunResult,
+    make_mesh,
+    make_mesh_runner,
+    shard_batches,
+)
+
+__all__ = [
+    "PARTITION_AXIS",
+    "MeshRunResult",
+    "make_mesh",
+    "make_mesh_runner",
+    "shard_batches",
+]

@@ -1,0 +1,104 @@
+"""Partition parallelism over a TPU device mesh.
+
+The reference's distribution stack (C3+C8, ``DDM_Process.py:58-72,216-226``)
+is: ship the whole dataframe to a Spark cluster, hash-shuffle on a
+``device_id`` column, run one independent Python worker per group, collect at
+the end. Here the same data-parallel strategy is expressed the TPU way
+(SURVEY.md §2 "TPU mapping"):
+
+* intra-chip: ``vmap`` of the compiled partition loop over the partition axis;
+* inter-chip: a 1-D ``jax.sharding.Mesh`` over the ``'partitions'`` axis with
+  ``NamedSharding`` — XLA splits the vmapped program across devices with no
+  communication during the stream (the loop is embarrassingly parallel,
+  matching the reference's zero worker↔worker traffic);
+* the end-of-run merge ("all devices find the same changes",
+  ``DDM_Process.py:89-92,258``) becomes an actual collective: a cross-
+  partition **drift vote** — for each microbatch step, the fraction of
+  partitions that flagged a change — reduced with ``psum`` semantics
+  (``jnp.sum`` over the sharded partition axis, which XLA lowers to an
+  all-reduce over ICI).
+
+Spark's RPC upload (``:222``) becomes ``jax.device_put`` against the sharding;
+its ``toPandas()`` collect (``:258``) becomes a device→host gather of the
+tiny flag table.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import DDMParams
+from ..engine.loop import Batches, FlagRows, make_partition_runner
+from ..models.base import Model
+
+PARTITION_AXIS = "partitions"
+
+
+def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
+    """1-D mesh over the partition (data-parallel) axis.
+
+    ``num_devices = 0`` uses every visible device. Partition counts must be a
+    multiple of the mesh size (the striper already produces equal-sized
+    partition grids, mirroring the reference's ≤1-row imbalance tolerance).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+
+
+class MeshRunResult(NamedTuple):
+    flags: FlagRows  # leaves [P, NB-1]
+    drift_vote: jax.Array  # [NB-1] f32: fraction of partitions flagging change
+
+
+def make_mesh_runner(
+    model: Model,
+    ddm_params: DDMParams,
+    mesh: Mesh | None,
+    *,
+    shuffle: bool = True,
+):
+    """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
+
+    ``batches`` leaves carry a leading partition axis ``[P, ...]`` sharded
+    over the mesh; ``keys`` is ``[P]`` of PRNG keys. With ``mesh=None`` the
+    same program runs single-device (one chip still vmaps over partitions).
+    """
+    run_one = make_partition_runner(model, ddm_params, shuffle=shuffle)
+    vmapped = jax.vmap(run_one)
+
+    def run(batches: Batches, keys: jax.Array) -> MeshRunResult:
+        flags = vmapped(batches, keys)
+        changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
+        # Cross-partition reduction: lowers to an ICI all-reduce when the
+        # partition axis is device-sharded (the psum drift vote of SURVEY §2).
+        vote = jnp.sum(changed, axis=0) / changed.shape[0]
+        return MeshRunResult(flags=flags, drift_vote=vote)
+
+    if mesh is None:
+        return jax.jit(run)
+
+    data_sharding = NamedSharding(mesh, P(PARTITION_AXIS))
+    out_sharding = MeshRunResult(
+        flags=FlagRows(*(data_sharding,) * 4),
+        drift_vote=NamedSharding(mesh, P()),  # replicated after the all-reduce
+    )
+    return jax.jit(run, in_shardings=(
+        Batches(*(data_sharding,) * 4),
+        data_sharding,
+    ), out_shardings=out_sharding)
+
+
+def shard_batches(batches: Batches, keys: jax.Array, mesh: Mesh | None):
+    """Host→device placement of the striped stream (the ``:222`` upload)."""
+    if mesh is None:
+        return jax.device_put(batches), jax.device_put(keys)
+    sh = NamedSharding(mesh, P(PARTITION_AXIS))
+    return jax.device_put(batches, sh), jax.device_put(keys, sh)

@@ -1,0 +1,35 @@
+"""Results persistence (reference C11, ``DDM_Process.py:263-273``).
+
+Append-one-row-per-run CSV with the reference's column schema (see
+``metrics.RESULT_COLUMNS``). Fixes quirk #1 of the SURVEY register: the
+reference *reads* ``ddm_cluster_runs.csv`` but *writes*
+``sparse_cluster_runs.csv`` (``:266`` vs ``:273``), breaking its own append
+chain; here one file is both read and written.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+from .metrics import RESULT_COLUMNS
+
+
+def append_result(path: str, row: list) -> None:
+    exists = os.path.exists(path)
+    with open(path, "a", newline="") as fh:
+        writer = csv.writer(fh)
+        if not exists:
+            writer.writerow(RESULT_COLUMNS)
+        writer.writerow([_fmt(v) for v in row])
+
+
+def read_results(path: str) -> list[dict]:
+    with open(path, newline="") as fh:
+        return list(csv.DictReader(fh))
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return repr(v)
+    return v

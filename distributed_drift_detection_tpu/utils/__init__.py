@@ -1,0 +1,3 @@
+from .timing import PhaseTimer, maybe_trace
+
+__all__ = ["PhaseTimer", "maybe_trace"]

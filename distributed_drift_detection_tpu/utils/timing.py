@@ -1,0 +1,40 @@
+"""Phase timers (aux subsystem: tracing/profiling, SURVEY.md §5).
+
+The reference's only instrumentation is one wall-clock span
+(``DDM_Process.py:224,260``). Here every run gets a per-phase breakdown
+(load/stripe/build/upload/detect/collect) plus an optional ``jax.profiler``
+trace for TPU work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class PhaseTimer:
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
+
+    def as_dict(self) -> dict:
+        return dict(self.phases)
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given, else a no-op."""
+    if trace_dir:
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield

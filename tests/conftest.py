@@ -1,0 +1,25 @@
+"""Test environment: CPU backend with 8 virtual devices.
+
+Replaces the reference's "edit the Spark master URL to test" story
+(SURVEY.md §4): multi-device paths are exercised on a virtual 8-device CPU
+mesh, the standard fake-backend trick.
+
+Env vars alone are not enough here: a site hook may pre-register an
+accelerator plugin and pin ``jax_platforms`` via the config (which outranks
+``JAX_PLATFORMS``), so we pin the config back to CPU before any backend
+initialises. Must run before the first array op anywhere in the test process.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,137 @@
+"""Pure-Python/NumPy oracle of the reference's drift-detection semantics.
+
+Implements, from the spec (SURVEY.md §3.3 and ``/root/reference/DDM_Process.py``
+behaviour — *not* copied code):
+
+* :class:`OracleDDM` — the skmultiflow-DDM recurrence as constructed at
+  ``DDM_Process.py:139`` (incremental p update, post-increment warm-up check,
+  `<=` minima update, warning/change thresholds).
+* :func:`oracle_run_ddm` — one microbatch: feed per-row errors, record first
+  warning and first change, break on change (``DDM_Process.py:141-152``).
+* :func:`oracle_partition_loop` — the full per-partition loop
+  (``DDM_Process.py:170-213``): train on batch *a*, predict batch *b*, detect,
+  rotate + reset + retrain on change; DDM state persists across batches.
+
+The classifier is injectable so the loop can be golden-tested exactly (e.g.
+majority-class) or statistically (learned models).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+F32 = np.float32
+
+
+@dataclass
+class OracleDDM:
+    """Sequential DDM detector, f32 arithmetic to mirror the TPU kernel.
+
+    ``incremental=True`` switches the running mean to skmultiflow's literal
+    ``p += (err - p) / i`` form (algebraically identical to sum/count; used to
+    check the kernel's formulation is not fp-fragile).
+    """
+
+    min_num_instances: int = 3
+    warning_level: float = 0.5
+    out_control_level: float = 1.5
+    incremental: bool = False
+    count: int = 0
+    err_sum: float = 0.0
+    p: float = 1.0
+    ps_min: float = math.inf
+    p_min: float = math.inf
+    s_min: float = math.inf
+    in_warning: bool = field(default=False, init=False)
+    in_change: bool = field(default=False, init=False)
+
+    def add_element(self, err: float) -> None:
+        self.count += 1
+        self.err_sum = float(F32(self.err_sum) + F32(err))
+        if self.incremental:
+            self.p = float(F32(self.p) + (F32(err) - F32(self.p)) / F32(self.count))
+            p = self.p
+        else:
+            p = float(F32(self.err_sum) / F32(self.count))
+        s = float(np.sqrt(max(F32(p) * F32(1.0 - p), F32(0.0)) / F32(self.count)))
+        ps = float(F32(p) + F32(s))
+
+        self.in_warning = False
+        self.in_change = False
+        if self.count + 1 < self.min_num_instances:
+            return
+        if ps <= self.ps_min:
+            self.ps_min, self.p_min, self.s_min = ps, p, s
+        if ps > float(F32(self.p_min) + F32(self.out_control_level) * F32(self.s_min)):
+            self.in_change = True
+        elif ps > float(F32(self.p_min) + F32(self.warning_level) * F32(self.s_min)):
+            self.in_warning = True
+
+
+def oracle_run_ddm(errs, rows, ddm: OracleDDM | None, **ddm_kw):
+    """One microbatch through the detector (reference C6 semantics).
+
+    Returns ``(flags, ddm)`` where flags is
+    ``(warn_local, warn_global, change_local, change_global)`` with −1
+    sentinels; ``rows`` supplies the global row id per element.
+    """
+    if ddm is None:
+        ddm = OracleDDM(**ddm_kw)
+    warn = (-1, -1)
+    change = (-1, -1)
+    for i, err in enumerate(errs):
+        ddm.add_element(float(err))
+        if ddm.in_warning and warn == (-1, -1):
+            warn = (i, int(rows[i]))
+        if ddm.in_change:
+            change = (i, int(rows[i]))
+            break
+    return (warn[0], warn[1], change[0], change[1]), ddm
+
+
+def oracle_partition_loop(X, y, rows, per_batch, fit, predict, **ddm_kw):
+    """Full per-partition loop (reference C7), no shuffling, no padding.
+
+    Args:
+      X, y, rows: the partition's stream, in order.
+      per_batch: microbatch length (last batch may be short).
+      fit: ``fit(X, y) -> model`` (pure).
+      predict: ``predict(model, X) -> preds``.
+
+    Returns:
+      list of per-batch flag tuples, one per batch after the first.
+    """
+    batches = [
+        (X[s : s + per_batch], y[s : s + per_batch], rows[s : s + per_batch])
+        for s in range(0, len(y), per_batch)
+    ]
+    ddm = None
+    retrain = True
+    model = None
+    batch_a = batches[0]
+    results = []
+    for batch_b in batches[1:]:
+        if retrain:
+            model = fit(batch_a[0], batch_a[1])
+            retrain = False
+        preds = predict(model, batch_b[0])
+        errs = (np.asarray(preds) != np.asarray(batch_b[1])).astype(np.float32)
+        flags, ddm = oracle_run_ddm(errs, batch_b[2], ddm, **ddm_kw)
+        results.append(flags)
+        if flags[3] > -1:
+            batch_a = batch_b
+            ddm = None
+            retrain = True
+    return results
+
+
+def majority_fit(X, y):
+    vals, counts = np.unique(np.asarray(y), return_counts=True)
+    return int(vals[np.argmax(counts)])
+
+
+def majority_predict(model, X):
+    return np.full(len(X), model, dtype=np.int32)

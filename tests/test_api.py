@@ -1,0 +1,84 @@
+"""End-to-end API tests on the shipped dataset (minimum slice, SURVEY.md §7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu import RunConfig, replace, run
+from distributed_drift_detection_tpu.results import read_results
+
+OUTDOOR = "/root/reference/outdoorStream.csv"
+
+
+def base_cfg(tmp_path, **kw):
+    # per_batch=50, not the reference's 100: outdoorStream concepts are
+    # exactly 100 rows, and a batch that aligns 1:1 with concepts gives a
+    # fresh detector 100% errors from its first element — DDM's structural
+    # blindspot (p_min pins at 1.0; the reference behaves identically, which
+    # is why its experiments only use mult_data ≥ 64 where concepts span many
+    # batches). Half-concept batches exercise the intended dynamics at mult=1.
+    return replace(
+        RunConfig(
+            dataset=OUTDOOR,
+            results_csv=str(tmp_path / "runs.csv"),
+            model="majority",
+            partitions=1,
+            per_batch=50,
+            shuffle_batches=False,
+        ),
+        **kw,
+    )
+
+
+def test_single_partition_outdoor(tmp_path):
+    """The minimum end-to-end slice: 1 chip, 1 partition, outdoorStream —
+    detections at concept boundaries with sub-batch delay."""
+    res = run(base_cfg(tmp_path))
+    m = res.metrics
+    # 40 concepts → 39 boundaries; sensitive 3/0.5/1.5 settings may fire a
+    # handful of extra times, but every boundary region must be hit.
+    assert m.num_detections >= 30
+    assert m.mean_delay_rows < 100  # < 1 batch average delay
+    changes = np.asarray(res.flags.change_global)
+    hit_concepts = set((changes[changes >= 0] // 100).tolist())
+    assert len(hit_concepts) >= 30
+
+
+def test_multi_partition_consistency(tmp_path):
+    """8 partitions on the same stream: every partition sees the same
+    boundaries (1/8-thinned), so detection count scales ~×8 and the mean
+    delay (in global rows) stays within one global batch-equivalent."""
+    res = run(base_cfg(tmp_path, partitions=8, mult_data=8))
+    per_part = res.metrics.detections_per_partition
+    assert per_part.min() >= 30
+    assert res.metrics.mean_delay_rows < 8 * 100
+
+
+def test_results_csv_roundtrip(tmp_path):
+    cfg = base_cfg(tmp_path, time_string="t0")
+    run(cfg)
+    run(replace(cfg, time_string="t1"))
+    rows = read_results(cfg.results_csv)
+    assert len(rows) == 2  # append chain works (quirk #1 fixed)
+    assert rows[0]["Spark App"].endswith("-t0")
+    assert float(rows[0]["Final Time"]) > 0
+    assert int(rows[0]["Instances"]) == 1
+    assert float(rows[0]["Rows Per Sec"]) > 0
+
+
+def test_timings_present(tmp_path):
+    res = run(base_cfg(tmp_path))
+    for phase in ("prepare", "upload", "detect", "collect"):
+        assert phase in res.timings
+
+
+def test_spark_backend_stub(tmp_path):
+    with pytest.raises(NotImplementedError, match="backend='jax'"):
+        run(base_cfg(tmp_path, backend="spark"))
+
+
+def test_linear_model_end_to_end(tmp_path):
+    res = run(base_cfg(tmp_path, model="linear", shuffle_batches=True))
+    assert res.metrics.num_detections >= 25
+    assert res.metrics.mean_delay_rows < 150

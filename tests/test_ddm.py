@@ -1,0 +1,161 @@
+"""Golden tests: JAX DDM kernels vs the NumPy oracle (SURVEY.md §4 strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu import DDMParams
+from distributed_drift_detection_tpu.ops import ddm_batch, ddm_init, ddm_scan, ddm_step
+
+from oracle import OracleDDM, oracle_run_ddm
+
+REF_PARAMS = DDMParams()  # 3 / 0.5 / 1.5, the reference's settings
+
+
+def planted_stream(rng, n, flip_at, p0=0.05, p1=0.6):
+    """Bernoulli error stream whose rate jumps at ``flip_at``."""
+    probs = np.where(np.arange(n) < flip_at, p0, p1)
+    return (rng.random(n) < probs).astype(np.float32)
+
+
+def run_oracle_stream(errs, params=REF_PARAMS, incremental=False):
+    ddm = OracleDDM(
+        min_num_instances=params.min_num_instances,
+        warning_level=params.warning_level,
+        out_control_level=params.out_control_level,
+        incremental=incremental,
+    )
+    warns, changes = [], []
+    for e in errs:
+        ddm.add_element(float(e))
+        warns.append(ddm.in_warning)
+        changes.append(ddm.in_change)
+    return np.array(warns), np.array(changes), ddm
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_step_matches_oracle_flags_and_state(seed):
+    rng = np.random.default_rng(seed)
+    errs = planted_stream(rng, 200, flip_at=120)
+    o_warn, o_change, o = run_oracle_stream(errs)
+
+    state, (warns, changes) = ddm_scan(ddm_init(), jnp.asarray(errs), REF_PARAMS)
+    np.testing.assert_array_equal(np.asarray(warns), o_warn)
+    np.testing.assert_array_equal(np.asarray(changes), o_change)
+    assert int(state.count) == o.count
+    np.testing.assert_allclose(float(state.err_sum), o.err_sum, rtol=1e-6)
+    np.testing.assert_allclose(float(state.p_min), o.p_min, rtol=1e-5)
+    np.testing.assert_allclose(float(state.s_min), o.s_min, rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_step_matches_incremental_form(seed):
+    """skmultiflow's p += (err-p)/i form detects at the same positions."""
+    rng = np.random.default_rng(100 + seed)
+    errs = planted_stream(rng, 300, flip_at=200, p0=0.1, p1=0.7)
+    _, o_change, _ = run_oracle_stream(errs, incremental=True)
+    _, (_, changes) = ddm_scan(ddm_init(), jnp.asarray(errs), REF_PARAMS)
+    np.testing.assert_array_equal(np.asarray(changes), o_change)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_batch_matches_sequential_single_batch(seed):
+    """Vectorised kernel == sequential loop + first-flag/early-break protocol."""
+    rng = np.random.default_rng(1000 + seed)
+    n = 100
+    errs = planted_stream(rng, n, flip_at=rng.integers(10, 90), p0=0.05, p1=0.8)
+    rows = np.arange(n)
+
+    (ow_l, ow_g, oc_l, oc_g), o = oracle_run_ddm(errs, rows, None)
+
+    state, res = ddm_batch(
+        ddm_init(), jnp.asarray(errs), jnp.ones(n, bool), REF_PARAMS
+    )
+    assert int(res.first_change) == oc_l
+    assert int(res.first_warning) == ow_l
+    if oc_l == -1:
+        # No change: carried state must match the oracle's.
+        assert int(state.count) == o.count
+        np.testing.assert_allclose(float(state.ps_min), o.ps_min, rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_batch_carries_state_across_batches(seed):
+    """Chained ddm_batch calls == one long sequential run (reference C7:202)."""
+    rng = np.random.default_rng(2000 + seed)
+    b, nb = 50, 6
+    errs = planted_stream(rng, b * nb, flip_at=rng.integers(120, 250), p0=0.02, p1=0.9)
+    rows = np.arange(b * nb)
+
+    # Oracle: feed batches, reset on change like the engine does.
+    ddm = None
+    oracle_flags = []
+    for k in range(nb):
+        fl, ddm = oracle_run_ddm(errs[k * b : (k + 1) * b], rows[k * b : (k + 1) * b], ddm)
+        oracle_flags.append(fl)
+        if fl[2] > -1:
+            ddm = None
+
+    state = ddm_init()
+    for k in range(nb):
+        state, res = ddm_batch(
+            state,
+            jnp.asarray(errs[k * b : (k + 1) * b]),
+            jnp.ones(b, bool),
+            REF_PARAMS,
+        )
+        assert int(res.first_change) == oracle_flags[k][2], f"batch {k}"
+        assert int(res.first_warning) == oracle_flags[k][0], f"batch {k}"
+        if int(res.first_change) >= 0:
+            state = ddm_init()
+
+
+def test_batch_padding_is_inert():
+    rng = np.random.default_rng(7)
+    errs = planted_stream(rng, 60, flip_at=40, p0=0.05, p1=0.9)
+    valid = np.ones(100, bool)
+    valid[60:] = False
+    padded = np.zeros(100, np.float32)
+    padded[:60] = errs
+
+    s_full, r_full = ddm_batch(ddm_init(), jnp.asarray(errs), jnp.ones(60, bool), REF_PARAMS)
+    s_pad, r_pad = ddm_batch(ddm_init(), jnp.asarray(padded), jnp.asarray(valid), REF_PARAMS)
+    assert int(r_full.first_change) == int(r_pad.first_change)
+    assert int(r_full.first_warning) == int(r_pad.first_warning)
+    assert int(s_full.count) == int(s_pad.count)
+    np.testing.assert_allclose(float(s_full.err_sum), float(s_pad.err_sum))
+
+
+def test_all_invalid_batch_is_noop():
+    state0 = ddm_init()
+    state, res = ddm_batch(
+        state0, jnp.ones(32, jnp.float32), jnp.zeros(32, bool), REF_PARAMS
+    )
+    assert int(res.first_change) == -1 and int(res.first_warning) == -1
+    assert int(state.count) == 0
+    assert float(state.err_sum) == 0.0
+    assert np.isinf(float(state.ps_min))
+
+
+def test_warmup_gate():
+    """min_num_instances=3 with post-increment counter: checks start at the
+    2nd element; a detector fed all-1 errors never fires (p+s at its min)."""
+    errs = jnp.ones(10, jnp.float32)
+    _, (warns, changes) = ddm_scan(ddm_init(), errs, REF_PARAMS)
+    assert not bool(jnp.any(changes))
+    # First element is inside warm-up regardless of value.
+    errs2 = jnp.asarray([1.0, 0.0, 0.0, 1.0, 1.0, 1.0], jnp.float32)
+    _, (w2, c2) = ddm_scan(ddm_init(), errs2, REF_PARAMS)
+    assert not bool(w2[0]) and not bool(c2[0])
+
+
+def test_step_and_batch_jit_and_vmap():
+    errs = jnp.asarray(np.random.default_rng(0).random((4, 64)) < 0.3, jnp.float32)
+    valid = jnp.ones((4, 64), bool)
+    states = jax.vmap(lambda _: ddm_init())(jnp.arange(4))
+    f = jax.jit(jax.vmap(lambda s, e, v: ddm_batch(s, e, v, REF_PARAMS)))
+    out_state, res = f(states, errs, valid)
+    assert out_state.count.shape == (4,)
+    assert res.first_change.shape == (4,)

@@ -1,0 +1,87 @@
+"""Stream synthesis + striping semantics (reference C2/C8)."""
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.io import (
+    StreamData,
+    load_stream,
+    stripe_partitions,
+    synthesize_stream,
+)
+
+OUTDOOR = "/root/reference/outdoorStream.csv"
+
+
+def toy_xy(n=100, f=3, classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.normal(size=(n, f)).astype(np.float32),
+        rng.integers(0, classes, n).astype(np.int64),
+    )
+
+
+def test_synthesize_sorted_and_scaled():
+    X, y = toy_xy()
+    s = synthesize_stream(X, y, mult_data=3, seed=1, standardize=False)
+    assert s.num_rows == 300
+    assert np.all(np.diff(s.y) >= 0)  # sorted by target (:51)
+    # duplication ×3 preserves per-class row counts ×3
+    _, counts0 = np.unique(y, return_counts=True)
+    _, counts = np.unique(s.y, return_counts=True)
+    np.testing.assert_array_equal(counts, counts0 * 3)
+    assert s.dist_between_changes == 300 // s.num_classes
+
+
+def test_synthesize_subsample():
+    X, y = toy_xy(n=200)
+    s = synthesize_stream(X, y, mult_data=0.25, seed=2)
+    assert s.num_rows == 50
+
+
+def test_outdoor_stream_geometry():
+    """The shipped dataset: 4000 rows, 21 features, 40 equal concepts
+    (SURVEY.md C16, verified empirically there)."""
+    s = load_stream(OUTDOOR, mult_data=1)
+    assert s.num_rows == 4000
+    assert s.num_features == 21
+    assert s.num_classes == 40
+    assert s.dist_between_changes == 100
+    counts = np.bincount(s.y)
+    assert counts.min() == counts.max() == 100
+
+
+@pytest.mark.parametrize("n,p,b", [(1000, 4, 50), (997, 8, 25), (40, 16, 7)])
+def test_striping_round_robin(n, p, b):
+    rng = np.random.default_rng(0)
+    s = StreamData(
+        X=rng.normal(size=(n, 3)).astype(np.float32),
+        y=rng.integers(0, 4, n).astype(np.int32),
+        num_classes=4,
+        dist_between_changes=n // 4,
+    )
+    batches = stripe_partitions(s, p, b)
+    assert batches.X.shape[0] == p
+    valid = np.asarray(batches.valid)
+    rows = np.asarray(batches.rows)
+    assert valid.sum() == n  # no row lost, no row duplicated
+    for part in range(p):
+        r = rows[part][valid[part]]
+        assert np.all(r % p == part)  # row i → partition i % P (:225)
+        assert np.all(np.diff(r) == p)  # stream order preserved within part
+    # content follows the rows index
+    flatX = np.asarray(batches.X).reshape(-1, 3)[valid.reshape(-1)]
+    np.testing.assert_array_equal(flatX, s.X[rows[valid]])
+
+
+def test_striping_rectangular_equal_shapes():
+    s = StreamData(
+        X=np.zeros((103, 2), np.float32),
+        y=np.zeros(103, np.int32),
+        num_classes=1,
+        dist_between_changes=103,
+    )
+    b = stripe_partitions(s, 4, 10)
+    # 103/4 → 26 rows max per partition → 3 batches of 10
+    assert b.X.shape == (4, 3, 10, 2)
+    assert np.asarray(b.valid).sum() == 103
