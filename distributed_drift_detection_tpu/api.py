@@ -64,7 +64,13 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     while n_dev > 1 and cfg.partitions % n_dev:
         n_dev -= 1
     mesh = make_mesh(n_dev) if n_dev > 1 else None
-    runner = make_mesh_runner(model, cfg.ddm, mesh, shuffle=cfg.shuffle_batches)
+    runner = make_mesh_runner(
+        model,
+        cfg.ddm,
+        mesh,
+        shuffle=cfg.shuffle_batches,
+        retrain_error_threshold=cfg.retrain_error_threshold,
+    )
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
     return PreparedRun(stream, batches, runner, keys, mesh)
 

@@ -62,6 +62,14 @@ class RunConfig:
 
     # --- detector (reference C6) ---
     ddm: DDMParams = DDMParams()
+    # Fallback retrain: force rotate+reset+retrain (without recording a DDM
+    # change) when a batch's error rate exceeds this threshold. Cures DDM's
+    # structural blindspot — a detector reset immediately before a ~100%-error
+    # regime pins p_min at 1.0 and never fires again. The reference ships the
+    # same idea as the *dead* constant REGRESSION_THRESH = 0.3
+    # (DDM_Process.py:31, never referenced); None (default) preserves
+    # reference behaviour exactly.
+    retrain_error_threshold: float | None = None
 
     # --- distribution (reference C8, DDM_Process.py:216-226) ---
     partitions: int = 8  # reference INSTANCES: row-striped stream partitions

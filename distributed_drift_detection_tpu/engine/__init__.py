@@ -1,7 +1,9 @@
+from .chunked import ChunkedDetector
 from .loop import Batches, FlagRows, LoopCarry, make_partition_runner, make_partition_step
 
 __all__ = [
     "Batches",
+    "ChunkedDetector",
     "FlagRows",
     "LoopCarry",
     "make_partition_runner",

@@ -1,0 +1,144 @@
+"""Chunked streaming execution for unbounded streams.
+
+The batch API (``api.run``) materialises the whole stream on device — fine up
+to the reference's 2 M-row scale, impossible for the BASELINE.json soak
+config (1e9 rows). This module runs the same compiled loop **incrementally**:
+the stream arrives in fixed-shape chunks of microbatches, the loop carry
+(model params, DDM state, batch_a, retrain flag, PRNG key) flows across
+chunks, and JAX's asynchronous dispatch double-buffers host→device transfer
+of chunk N+1 against compute of chunk N (the "host-feed bandwidth" hard part
+of SURVEY.md §7).
+
+The carry is also the **checkpoint surface** (SURVEY.md §5 checkpoint/resume):
+a few KB per partition — see ``utils/checkpoint.py`` and
+:meth:`ChunkedDetector.save` / :meth:`ChunkedDetector.restore`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import DDMParams
+from ..models.base import Model
+from ..ops.ddm import ddm_init
+from .loop import Batches, FlagRows, LoopCarry, make_partition_step
+
+
+class ChunkResult(NamedTuple):
+    flags: FlagRows  # leaves [P, chunk_batches]
+    chunk_index: int
+
+
+class ChunkedDetector:
+    """Stateful driver around the jitted per-chunk scan.
+
+    All chunks must share the shape ``[P, CB, B]`` (+ feature dim); the first
+    chunk's first microbatch seeds ``batch_a`` (the reference consumes
+    ``batches[0]`` the same way, ``DDM_Process.py:187``).
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        ddm_params: DDMParams = DDMParams(),
+        *,
+        partitions: int,
+        shuffle: bool = True,
+        retrain_error_threshold: float | None = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.partitions = partitions
+        step = make_partition_step(
+            model,
+            ddm_params,
+            shuffle=shuffle,
+            retrain_error_threshold=retrain_error_threshold,
+        )
+
+        def run_chunk(carry: LoopCarry, batches: Batches):
+            return lax.scan(step, carry, batches)
+
+        self._run_chunk = jax.jit(jax.vmap(run_chunk))
+        self._seed = seed
+        self.carry: LoopCarry | None = None
+        self.batches_done = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _init_carry(self, first: Batches) -> LoopCarry:
+        keys = jax.random.split(jax.random.key(self._seed), self.partitions)
+        init_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        params = jax.vmap(self.model.init)(init_keys[:, 1])
+        return LoopCarry(
+            params=params,
+            ddm=jax.vmap(lambda _: ddm_init())(jnp.arange(self.partitions)),
+            a_X=first.X[:, 0],
+            a_y=first.y[:, 0],
+            a_w=first.valid[:, 0].astype(jnp.float32),
+            retrain=jnp.ones(self.partitions, bool),
+            key=init_keys[:, 0],
+        )
+
+    def feed(self, chunk: Batches) -> FlagRows:
+        """Process one ``[P, CB, B]`` chunk; returns flags ``[P, CB']``.
+
+        The first chunk loses its first microbatch to ``batch_a`` seeding.
+        Does not block: results are JAX async values, so the caller can
+        prefetch/construct the next chunk while the device runs.
+        """
+        chunk = jax.tree.map(jnp.asarray, chunk)
+        if self.carry is None:
+            self.carry = self._init_carry(chunk)
+            chunk = jax.tree.map(lambda x: x[:, 1:], chunk)
+        self.carry, flags = self._run_chunk(self.carry, chunk)
+        self.batches_done += int(chunk.y.shape[1])
+        return flags
+
+    def run(self, chunks: Iterator[Batches], progress=None) -> FlagRows:
+        """Drain an iterator of chunks; concatenates flags on host."""
+        out = []
+        for i, chunk in enumerate(chunks):
+            flags = self.feed(chunk)
+            out.append(flags)  # async; host copy deferred to the concat below
+            if progress is not None:
+                progress(i, self.batches_done)
+        host = [jax.tree.map(np.asarray, f) for f in out]
+        return FlagRows(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
+
+    # -- checkpoint / resume (SURVEY.md §5) ----------------------------------
+
+    def save(self, path: str) -> None:
+        from ..utils.checkpoint import save_checkpoint
+
+        assert self.carry is not None, "nothing to checkpoint yet"
+        save_checkpoint(
+            path,
+            self.carry,
+            meta={
+                "batches_done": self.batches_done,
+                "partitions": self.partitions,
+            },
+        )
+
+    def restore(self, path: str, example_chunk: Batches | None = None) -> dict:
+        """Resume from a checkpoint. A fresh detector needs ``example_chunk``
+        (any chunk of the right shapes) to rebuild the carry structure."""
+        from ..utils.checkpoint import load_checkpoint
+
+        template = self.carry
+        if template is None:
+            if example_chunk is None:
+                raise ValueError(
+                    "restore() on a fresh detector needs example_chunk to "
+                    "rebuild the carry structure"
+                )
+            template = self._init_carry(jax.tree.map(jnp.asarray, example_chunk))
+        self.carry, meta = load_checkpoint(path, template)
+        self.batches_done = int(meta["batches_done"])
+        return meta

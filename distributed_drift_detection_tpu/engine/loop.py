@@ -48,12 +48,15 @@ class Batches(NamedTuple):
 
 
 class FlagRows(NamedTuple):
-    """Per-batch detection flags — reference output schema (−1 sentinels)."""
+    """Per-batch detection flags — reference output schema (−1 sentinels),
+    plus ``forced_retrain`` marking fallback retrains (see
+    ``RunConfig.retrain_error_threshold``; always False when disabled)."""
 
     warning_local: jax.Array  # index within the (shuffled) batch
     warning_global: jax.Array  # global stream position
     change_local: jax.Array
     change_global: jax.Array
+    forced_retrain: jax.Array  # bool
 
 
 class LoopCarry(NamedTuple):
@@ -77,7 +80,11 @@ def _gather_row(rows, idx):
 
 
 def make_partition_step(
-    model: Model, ddm_params: DDMParams, *, shuffle: bool = True
+    model: Model,
+    ddm_params: DDMParams,
+    *,
+    shuffle: bool = True,
+    retrain_error_threshold: float | None = None,
 ):
     """Build the scan body: ``(carry, batch) -> (carry, FlagRows)``."""
 
@@ -107,22 +114,34 @@ def make_partition_step(
         new_ddm, res = ddm_batch(carry.ddm, errs, b_valid, ddm_params)
         change = (res.first_change >= 0) & nonempty
 
+        # Optional fallback (config.retrain_error_threshold): a saturated
+        # error rate with no DDM firing means the detector is blind-spotted;
+        # rotate/reset/retrain without recording a change. Static no-op (same
+        # compiled graph) when disabled.
+        if retrain_error_threshold is not None:
+            err_rate = jnp.sum(errs * b_w) / jnp.maximum(jnp.sum(b_w), 1.0)
+            forced = nonempty & ~change & (err_rate > retrain_error_threshold)
+        else:
+            forced = jnp.bool_(False)
+        rotate = change | forced
+
         flags = FlagRows(
             warning_local=res.first_warning,
             warning_global=_gather_row(b_rows, res.first_warning),
             change_local=res.first_change,
             change_global=_gather_row(b_rows, res.first_change),
+            forced_retrain=forced,
         )
 
         # On change: rotate batch_a ← batch_b, reset detector, retrain (C7
         # :207-210). Empty (fully padded) batches are inert.
         new_carry = LoopCarry(
             params=params,
-            ddm=_select(change, ddm_init(), new_ddm),
-            a_X=_select(change, b_X, carry.a_X),
-            a_y=_select(change, b_y, carry.a_y),
-            a_w=_select(change, b_w, carry.a_w),
-            retrain=jnp.where(nonempty, change, carry.retrain),
+            ddm=_select(rotate, ddm_init(), new_ddm),
+            a_X=_select(rotate, b_X, carry.a_X),
+            a_y=_select(rotate, b_y, carry.a_y),
+            a_w=_select(rotate, b_w, carry.a_w),
+            retrain=jnp.where(nonempty, rotate, carry.retrain),
             key=key,
         )
         return new_carry, flags
@@ -131,14 +150,23 @@ def make_partition_step(
 
 
 def make_partition_runner(
-    model: Model, ddm_params: DDMParams, *, shuffle: bool = True
+    model: Model,
+    ddm_params: DDMParams,
+    *,
+    shuffle: bool = True,
+    retrain_error_threshold: float | None = None,
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
 
     The returned function is pure and jit/vmap-compatible; ``FlagRows`` leaves
     have shape ``[NB-1]``.
     """
-    step = make_partition_step(model, ddm_params, shuffle=shuffle)
+    step = make_partition_step(
+        model,
+        ddm_params,
+        shuffle=shuffle,
+        retrain_error_threshold=retrain_error_threshold,
+    )
 
     def run(batches: Batches, key: jax.Array) -> FlagRows:
         key, k_init = jax.random.split(key)

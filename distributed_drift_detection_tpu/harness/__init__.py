@@ -1,0 +1,13 @@
+from .aggregate import aggregate, load_runs, scaleup_table, speedup_table, write_tables
+from .grid import grid_configs, missing_configs, run_grid
+
+__all__ = [
+    "aggregate",
+    "load_runs",
+    "scaleup_table",
+    "speedup_table",
+    "write_tables",
+    "grid_configs",
+    "missing_configs",
+    "run_grid",
+]

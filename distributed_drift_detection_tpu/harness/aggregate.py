@@ -1,0 +1,120 @@
+"""Results aggregation and paper-style tables (reference C13/C15).
+
+Mirrors the notebook pipeline (``Plot Results.ipynb``): load the runs CSV,
+derive the dataset from the app name, group by (Dataset, Instances,
+Multiplier, Memory, Cores), compute mean/variance/trial-count of Final Time
+and Average Distance (cell 0); emit the LaTeX-ready CSV tables —
+``time_table.csv`` (cell 8), ``drift_delay.csv`` (cell 11),
+``drift_delay_var.csv`` (cell 12) — plus speedup/scaleup tables (cells 5-6).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+GROUP_COLS = ["Dataset", "Instances", "Data Multiplier", "Memory", "Cores"]
+
+
+def load_runs(results_csv: str) -> pd.DataFrame:
+    df = pd.read_csv(results_csv)
+    if "Dataset" not in df.columns:
+        # Legacy rows (reference schema): dataset from the app name
+        # "<dataset>-<time-string>" (C13). Fragile for hyphenated paths,
+        # which is why the native schema carries an explicit Dataset column.
+        df["Dataset"] = df["Spark App"].str.split("-").str[0].map(os.path.basename)
+    for col in ("Final Time", "Average Distance", "Data Multiplier"):
+        df[col] = pd.to_numeric(df[col], errors="coerce")
+    return df
+
+
+def aggregate(df: pd.DataFrame) -> pd.DataFrame:
+    """Per-config mean/variance/count over trials (notebook cell 0)."""
+    g = df.groupby(GROUP_COLS, dropna=False)
+    out = g.agg(
+        mean_time=("Final Time", "mean"),
+        var_time=("Final Time", "var"),
+        mean_delay=("Average Distance", "mean"),
+        var_delay=("Average Distance", "var"),
+        trials=("Final Time", "count"),
+    ).reset_index()
+    if "Rows Per Sec" in df.columns:
+        out = out.merge(
+            g.agg(mean_rows_per_sec=("Rows Per Sec", "mean")).reset_index(),
+            on=GROUP_COLS,
+        )
+    return out
+
+
+def speedup_table(agg: pd.DataFrame) -> pd.DataFrame:
+    """T(min instances) / T(n) per (Dataset, Multiplier, Cores) — cell 5."""
+    rows = []
+    for (ds, mult, cores), grp in agg.groupby(["Dataset", "Data Multiplier", "Cores"]):
+        grp = grp.sort_values("Instances")
+        base = grp["mean_time"].iloc[0]
+        for _, r in grp.iterrows():
+            rows.append(
+                {
+                    "Dataset": ds,
+                    "Data Multiplier": mult,
+                    "Cores": cores,
+                    "Instances": r["Instances"],
+                    "mean_time": r["mean_time"],
+                    "speedup": base / r["mean_time"] if r["mean_time"] else np.nan,
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+def scaleup_table(agg: pd.DataFrame, coupling: float = 16.0) -> pd.DataFrame:
+    """Scaleup (cell 6): problem size grows ∝ instances; configs where
+    Multiplier == coupling × Instances are comparable — perfect scaleup keeps
+    time constant."""
+    sel = agg[np.isclose(agg["Data Multiplier"], coupling * agg["Instances"])]
+    sel = sel.sort_values(["Dataset", "Cores", "Instances"])
+    out = sel.copy()
+    base = sel.groupby(["Dataset", "Cores"])["mean_time"].transform("first")
+    out["scaleup"] = base / out["mean_time"]
+    return out
+
+
+def write_tables(results_csv: str, out_dir: str = ".") -> dict[str, str]:
+    """Emit the cell 8/11/12 CSV tables; returns {name: path}."""
+    df = load_runs(results_csv)
+    agg = aggregate(df)
+    paths = {}
+
+    def emit(name: str, frame: pd.DataFrame):
+        path = os.path.join(out_dir, name)
+        frame.to_csv(path, index=False)
+        paths[name] = path
+
+    emit(
+        "time_table.csv",
+        agg.pivot_table(
+            index=["Dataset", "Data Multiplier", "Cores"],
+            columns="Instances",
+            values="mean_time",
+        ).reset_index(),
+    )
+    emit(
+        "drift_delay.csv",
+        agg.pivot_table(
+            index=["Dataset", "Data Multiplier", "Cores"],
+            columns="Instances",
+            values="mean_delay",
+        ).reset_index(),
+    )
+    emit(
+        "drift_delay_var.csv",
+        agg.pivot_table(
+            index=["Dataset", "Data Multiplier", "Cores"],
+            columns="Instances",
+            values="var_delay",
+        ).reset_index(),
+    )
+    emit("speedup_table.csv", speedup_table(agg))
+    emit("scaleup_table.csv", scaleup_table(agg))
+    return paths

@@ -1,0 +1,128 @@
+"""Paper-figure suite (reference C15, ``Plot Results.ipynb`` cells 5-12).
+
+Renders the five figures of the reference's evaluation from a runs CSV:
+speedup vs instances (log2 x, cell 5), scaleup (cell 6), raw time (cell 7),
+detection delay as % of stream (cell 9), delay variance (cell 10). Saved
+under descriptive names (the notebook used ``0.pdf, 1.pdf, …``).
+
+Matplotlib is imported lazily; :func:`render_all` degrades to tables-only
+when it is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .aggregate import aggregate, load_runs, scaleup_table, speedup_table, write_tables
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _per_cores_lines(ax, frame, ycol, label_fmt="{} cores"):
+    for cores, grp in frame.groupby("Cores"):
+        grp = grp.sort_values("Instances")
+        ax.plot(grp["Instances"], grp[ycol], marker="o", label=label_fmt.format(cores))
+    ax.set_xscale("log", base=2)
+    ax.set_xlabel("Instances (partitions)")
+    ax.legend()
+
+
+def plot_speedup(agg, out_path: str):
+    plt = _plt()
+    sp = speedup_table(agg)
+    mults = sorted(sp["Data Multiplier"].unique())
+    fig, axes = plt.subplots(1, max(len(mults), 1), figsize=(4 * max(len(mults), 1), 3.2))
+    axes = [axes] if len(mults) <= 1 else list(axes)
+    for ax, mult in zip(axes, mults):
+        _per_cores_lines(ax, sp[sp["Data Multiplier"] == mult], "speedup")
+        ax.set_title(f"mult={mult:g}")
+        ax.set_ylabel("speedup  T(1)/T(n)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def plot_time(agg, out_path: str):
+    plt = _plt()
+    mults = sorted(agg["Data Multiplier"].unique())
+    fig, axes = plt.subplots(1, max(len(mults), 1), figsize=(4 * max(len(mults), 1), 3.2))
+    axes = [axes] if len(mults) <= 1 else list(axes)
+    for ax, mult in zip(axes, mults):
+        _per_cores_lines(ax, agg[agg["Data Multiplier"] == mult], "mean_time")
+        ax.set_title(f"mult={mult:g}")
+        ax.set_ylabel("Final Time (s)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def plot_scaleup(agg, out_path: str, coupling: float = 16.0):
+    plt = _plt()
+    sc = scaleup_table(agg, coupling)
+    fig, ax = plt.subplots(figsize=(4.5, 3.2))
+    if len(sc):
+        _per_cores_lines(ax, sc, "scaleup")
+    ax.set_ylabel(f"scaleup (size = {coupling:g}×instances)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def plot_delay(agg, out_path: str, stream_rows_per_mult: int = 4000, variance=False):
+    """Delay as % of stream length (cell 9) or its variance (cell 10)."""
+    plt = _plt()
+    col = "var_delay" if variance else "mean_delay"
+    frame = agg.copy()
+    frame["delay_pct"] = 100.0 * frame[col] / (
+        frame["Data Multiplier"] * stream_rows_per_mult
+    )
+    mults = sorted(frame["Data Multiplier"].unique())
+    fig, axes = plt.subplots(1, max(len(mults), 1), figsize=(4 * max(len(mults), 1), 3.2))
+    axes = [axes] if len(mults) <= 1 else list(axes)
+    for ax, mult in zip(axes, mults):
+        _per_cores_lines(ax, frame[frame["Data Multiplier"] == mult], "delay_pct")
+        ax.set_title(f"mult={mult:g}")
+        ax.set_ylabel(("delay variance" if variance else "mean delay") + " (% stream)")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def render_all(results_csv: str, out_dir: str = "figures") -> dict[str, str]:
+    """Tables + all five figures. Returns {artifact: path}."""
+    os.makedirs(out_dir, exist_ok=True)
+    artifacts = write_tables(results_csv, out_dir)
+    try:
+        _plt()
+    except ImportError:
+        return artifacts
+    agg = aggregate(load_runs(results_csv))
+    for name, fn in [
+        ("speedup.pdf", plot_speedup),
+        ("time.pdf", plot_time),
+        ("scaleup.pdf", plot_scaleup),
+    ]:
+        path = os.path.join(out_dir, name)
+        fn(agg, path)
+        artifacts[name] = path
+    for name, var in [("delay_pct.pdf", False), ("delay_var.pdf", True)]:
+        path = os.path.join(out_dir, name)
+        plot_delay(agg, path, variance=var)
+        artifacts[name] = path
+    return artifacts
+
+
+if __name__ == "__main__":
+    import sys
+
+    csv = sys.argv[1] if len(sys.argv) > 1 else "ddm_cluster_runs.csv"
+    out = sys.argv[2] if len(sys.argv) > 2 else "figures"
+    for k, v in render_all(csv, out).items():
+        print(k, "->", v)

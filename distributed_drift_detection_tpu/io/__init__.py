@@ -1,9 +1,26 @@
+from .feeder import chunk_stream_arrays, generator_chunks
 from .stream import StreamData, load_csv, load_stream, stripe_partitions, synthesize_stream
+from .synth import (
+    as_stream,
+    hyperplane_chunk,
+    hyperplane_stream,
+    planted_prototypes,
+    sea_chunk,
+    sea_stream,
+)
 
 __all__ = [
+    "chunk_stream_arrays",
+    "generator_chunks",
     "StreamData",
     "load_csv",
     "load_stream",
     "stripe_partitions",
     "synthesize_stream",
+    "as_stream",
+    "hyperplane_chunk",
+    "hyperplane_stream",
+    "planted_prototypes",
+    "sea_chunk",
+    "sea_stream",
 ]

@@ -1,0 +1,57 @@
+"""Host→device chunk feeder for the streaming engine.
+
+Replaces the reference's one-shot driver upload (``spark.createDataFrame`` of
+the entire dataset, ``DDM_Process.py:222``) with an incremental feed: a
+chunk-exact generator (``io.synth``) or an in-memory stream is cut into
+fixed-shape ``[P, CB, B]`` chunks whose striping matches the batch API's
+``stripe_partitions`` exactly, so chunked and one-shot runs see identical
+per-partition streams. JAX async dispatch overlaps the NumPy assembly and
+host→device copy of chunk N+1 with device compute of chunk N (the
+double-buffering called for by SURVEY.md §7 "host-feed bandwidth").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ..engine.loop import Batches
+from .stream import stripe_chunk
+
+
+def chunk_stream_arrays(
+    X: np.ndarray,
+    y: np.ndarray,
+    partitions: int,
+    per_batch: int,
+    chunk_batches: int,
+    start_row: int = 0,
+) -> Iterator[Batches]:
+    """Chunk an in-memory stream; rows are global positions + start_row."""
+    n, f = X.shape
+    p, b, cb = partitions, per_batch, chunk_batches
+    rows_per_chunk = p * b * cb
+    for s in range(0, n, rows_per_chunk):
+        e = min(s + rows_per_chunk, n)
+        yield stripe_chunk(X[s:e], y[s:e], s + start_row, p, b, cb)
+
+
+def generator_chunks(
+    chunk_fn: Callable[[int, int], tuple[np.ndarray, np.ndarray]],
+    total_rows: int,
+    partitions: int,
+    per_batch: int,
+    chunk_batches: int,
+) -> Iterator[Batches]:
+    """Chunks from a chunk-exact generator ``chunk_fn(start, stop) -> (X, y)``
+    (e.g. ``functools.partial(sea_chunk, seed, drift_every=...)`` adapted to
+    (start, stop)). Generates only one chunk of rows at a time — 1e9-row
+    soaks never materialise the stream.
+    """
+    p, b, cb = partitions, per_batch, chunk_batches
+    rows_per_chunk = p * b * cb
+    for s in range(0, total_rows, rows_per_chunk):
+        e = min(s + rows_per_chunk, total_rows)
+        X, y = chunk_fn(s, e)
+        yield stripe_chunk(X, y, s, p, b, cb)

@@ -105,18 +105,20 @@ def load_stream(
     return synthesize_stream(X, y, mult_data, seed, standardize)
 
 
-def stripe_partitions(stream: StreamData, partitions: int, per_batch: int) -> Batches:
-    """Row-stripe the stream over P partitions and slice into microbatches.
+def stripe_chunk(
+    X: np.ndarray, y: np.ndarray, start_row: int, partitions: int, per_batch: int, nb: int
+) -> Batches:
+    """Pad + row-stripe one contiguous span of the stream into ``[P, NB, B]``.
 
-    Returns :class:`Batches` with leading partition axis: ``X [P, NB, B, F]``,
-    ``y/rows/valid [P, NB, B]``. ``rows`` holds global stream positions so the
-    delay metric (global position % concept length) works per the reference's
-    intent.
+    Row ``start_row + i`` goes to partition ``(start_row + i) % P`` at the
+    next slot (C8 ``:225`` placement); ``start_row`` must be a multiple of P
+    so striping is chunking-invariant. The single implementation shared by
+    the one-shot path (:func:`stripe_partitions`) and the chunk feeder
+    (``io.feeder``) — their bit-exact agreement is a correctness contract
+    (see ``tests/test_chunked.py``).
     """
-    n, f = stream.X.shape
+    n = len(y)
     p, b = partitions, per_batch
-    per_part = -(-n // p)  # ceil: partition sizes differ by ≤ 1 (C8)
-    nb = -(-per_part // b)
     padded = p * nb * b
 
     def pad(arr, fill):
@@ -124,18 +126,31 @@ def stripe_partitions(stream: StreamData, partitions: int, per_batch: int) -> Ba
         out[:n] = arr
         return out
 
-    rows = np.arange(padded, dtype=np.int32)
-    valid = rows < n
+    rows = start_row + np.arange(padded, dtype=np.int64)
+    valid = np.arange(padded) < n
 
     def stripe(arr):
-        # position i → partition i % P, slot i // P  (C8 :225)
         return np.ascontiguousarray(
             arr.reshape(nb * b, p, *arr.shape[1:]).swapaxes(0, 1)
         ).reshape(p, nb, b, *arr.shape[1:])
 
     return Batches(
-        X=stripe(pad(stream.X, 0.0)),
-        y=stripe(pad(stream.y, 0)),
-        rows=stripe(rows),
+        X=stripe(pad(np.asarray(X, np.float32), 0.0)),
+        y=stripe(pad(np.asarray(y, np.int32), 0)),
+        rows=stripe(rows.astype(np.int32)),
         valid=stripe(valid),
     )
+
+
+def stripe_partitions(stream: StreamData, partitions: int, per_batch: int) -> Batches:
+    """Row-stripe the whole stream over P partitions (one-shot path).
+
+    Returns :class:`Batches` with leading partition axis: ``X [P, NB, B, F]``,
+    ``y/rows/valid [P, NB, B]``. ``rows`` holds global stream positions so the
+    delay metric (global position % concept length) works per the reference's
+    intent.
+    """
+    n = stream.num_rows
+    per_part = -(-n // partitions)  # ceil: partition sizes differ by ≤ 1 (C8)
+    nb = -(-per_part // per_batch)
+    return stripe_chunk(stream.X, stream.y, 0, partitions, per_batch, nb)

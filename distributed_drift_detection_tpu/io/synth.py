@@ -1,0 +1,144 @@
+"""Synthetic drift-stream generators.
+
+The reference's only stream synthesis is volume-scaling a CSV (C2,
+``DDM_Process.py:38-55``). For scale tests beyond the shipped data
+(BASELINE.json config #4: "Synthetic SEA/HYPERPLANE generator, 1e9 rows,
+abrupt+gradual drifts — sustained-throughput soak") this module provides
+classic stream-benchmark generators plus the planted-prototype stream used
+throughout the test suite. All generators are seeded and chunk-friendly
+(generate any ``[start, stop)`` row range deterministically), so the chunked
+engine can stream unbounded data without materialising it.
+
+Every generator returns (or fills) ``X [N,F] f32`` and ``y [N] i32`` with
+known drift positions; :func:`as_stream` wraps them into a
+:class:`~..io.stream.StreamData` with the concept spacing the delay metric
+needs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .stream import StreamData
+
+
+def planted_prototypes(
+    seed: int,
+    concepts: int = 40,
+    rows_per_concept: int = 100,
+    features: int = 21,
+    noise: float = 0.05,
+    label_flip: float = 0.0,
+) -> StreamData:
+    """Concept k = noisy copies of prototype k, labelled k — the same
+    geometry as a volume-scaled outdoorStream (C2: sorted by target, equal
+    concepts)."""
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(concepts, features)).astype(np.float32) * 3.0
+    X = np.concatenate(
+        [
+            protos[k]
+            + noise * rng.normal(size=(rows_per_concept, features)).astype(np.float32)
+            for k in range(concepts)
+        ]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(concepts, dtype=np.int32), rows_per_concept)
+    if label_flip:
+        flip = rng.random(len(y)) < label_flip
+        y[flip] = rng.integers(0, concepts, flip.sum()).astype(np.int32)
+    return StreamData(X, y, concepts, rows_per_concept)
+
+
+# SEA concept thresholds (Street & Kim 2001): label = f0 + f1 <= theta.
+_SEA_THETAS = (8.0, 9.0, 7.0, 9.5)
+
+
+def _row_uniforms(seed: int, start: int, n: int, per_row: int, stream_id: int):
+    """``[n, per_row]`` uniforms that depend only on (seed, stream_id, row):
+    counter-based Philox advanced to ``start * per_row``, so any chunking of
+    the stream reproduces identical rows — the property the soak feeder
+    relies on."""
+    width = -4 * (-per_row // 4)  # one Philox advance unit = one 4x64-bit
+    bitgen = np.random.Philox(key=np.uint64(seed) ^ (np.uint64(stream_id) << 32))
+    bitgen.advance(int(start) * (width // 4))  # block = 4 f64 draws
+    return np.random.Generator(bitgen).random((n, width))[:, :per_row]
+
+
+def sea_chunk(seed: int, start: int, stop: int, drift_every: int, noise: float = 0.0):
+    """Rows [start, stop) of an endless SEA stream with abrupt drifts.
+
+    Features ~ U[0,10)^3; the concept of block ``row // drift_every`` cycles
+    through the four SEA thresholds. ``noise`` flips that fraction of labels.
+    Chunk-exact: deterministic per (seed, row) regardless of chunking.
+    """
+    n = stop - start
+    rows = np.arange(start, stop, dtype=np.int64)
+    u = _row_uniforms(seed, start, n, per_row=4, stream_id=0)
+    X = (u[:, :3] * 10.0).astype(np.float32)
+    theta = np.asarray(_SEA_THETAS, np.float32)[(rows // drift_every) % len(_SEA_THETAS)]
+    y = (X[:, 0] + X[:, 1] <= theta).astype(np.int32)
+    if noise:
+        y[u[:, 3] < noise] ^= 1
+    return X, y
+
+
+def hyperplane_chunk(
+    seed: int,
+    start: int,
+    stop: int,
+    features: int = 10,
+    drift_every: int = 0,
+    rotate_scale: float = 0.0,
+):
+    """Rows [start, stop) of a rotating-hyperplane stream (Hulten et al.).
+
+    label = (w_c · x > 0.5·Σw_c) with weights w_c per concept block
+    (``drift_every`` > 0 → abrupt redraws) and an optional gradual rotation
+    (``rotate_scale`` > 0 adds a smooth per-row drift term). Chunk-exact like
+    :func:`sea_chunk`.
+    """
+    n = stop - start
+    rows = np.arange(start, stop, dtype=np.int64)
+    X = _row_uniforms(seed, start, n, per_row=features, stream_id=1).astype(np.float32)
+
+    if drift_every > 0:
+        blocks = rows // drift_every
+        uniq = np.unique(blocks)
+        # weights per concept block, deterministic in (seed, block)
+        w = np.stack(
+            [_row_uniforms(seed, int(b), 1, features, stream_id=2)[0] for b in uniq]
+        ).astype(np.float32)
+        w_rows = w[np.searchsorted(uniq, blocks)]
+    else:
+        base = _row_uniforms(seed, 0, 1, features, stream_id=2)[0].astype(np.float32)
+        w_rows = np.broadcast_to(base, (n, features)).copy()
+
+    if rotate_scale:
+        phase = (rows[:, None] * rotate_scale).astype(np.float32)
+        w_rows = w_rows + 0.3 * np.sin(phase + np.arange(features, dtype=np.float32))
+
+    margin = (X * w_rows).sum(1) - 0.5 * w_rows.sum(1)
+    y = (margin > 0).astype(np.int32)
+    return X, y
+
+
+def as_stream(X: np.ndarray, y: np.ndarray, drift_every: int) -> StreamData:
+    """Wrap generated arrays as a StreamData with known concept spacing."""
+    return StreamData(
+        X=np.ascontiguousarray(X, np.float32),
+        y=np.ascontiguousarray(y, np.int32),
+        num_classes=int(y.max()) + 1,
+        dist_between_changes=drift_every,
+    )
+
+
+def sea_stream(seed: int, n_rows: int, drift_every: int, noise: float = 0.0) -> StreamData:
+    X, y = sea_chunk(seed, 0, n_rows, drift_every, noise)
+    return as_stream(X, y, drift_every)
+
+
+def hyperplane_stream(
+    seed: int, n_rows: int, features: int = 10, drift_every: int = 0
+) -> StreamData:
+    X, y = hyperplane_chunk(seed, 0, n_rows, features, drift_every)
+    return as_stream(X, y, drift_every or n_rows)

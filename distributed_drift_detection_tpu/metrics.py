@@ -57,6 +57,8 @@ RESULT_COLUMNS = [
     "Cores",
     "Final Time",
     "Average Distance",
+    "Dataset",
+    "Per Batch",
     "Rows",
     "Rows Per Sec",
     "Detections",
@@ -66,6 +68,8 @@ RESULT_COLUMNS = [
 def result_row(
     cfg: Any, total_time: float, metrics: DelayMetrics, num_rows: int
 ) -> list:
+    import os
+
     return [
         cfg.resolved_app_name(),
         cfg.time_string,
@@ -76,6 +80,8 @@ def result_row(
         cfg.cores,
         total_time,
         metrics.mean_delay_rows,
+        os.path.basename(cfg.dataset),
+        cfg.per_batch,
         num_rows,
         num_rows / total_time if total_time > 0 else float("nan"),
         metrics.num_detections,

@@ -64,6 +64,7 @@ def make_mesh_runner(
     mesh: Mesh | None,
     *,
     shuffle: bool = True,
+    retrain_error_threshold: float | None = None,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
@@ -71,7 +72,12 @@ def make_mesh_runner(
     over the mesh; ``keys`` is ``[P]`` of PRNG keys. With ``mesh=None`` the
     same program runs single-device (one chip still vmaps over partitions).
     """
-    run_one = make_partition_runner(model, ddm_params, shuffle=shuffle)
+    run_one = make_partition_runner(
+        model,
+        ddm_params,
+        shuffle=shuffle,
+        retrain_error_threshold=retrain_error_threshold,
+    )
     vmapped = jax.vmap(run_one)
 
     def run(batches: Batches, keys: jax.Array) -> MeshRunResult:
@@ -87,7 +93,7 @@ def make_mesh_runner(
 
     data_sharding = NamedSharding(mesh, P(PARTITION_AXIS))
     out_sharding = MeshRunResult(
-        flags=FlagRows(*(data_sharding,) * 4),
+        flags=FlagRows(*(data_sharding,) * len(FlagRows._fields)),
         drift_vote=NamedSharding(mesh, P()),  # replicated after the all-reduce
     )
     return jax.jit(run, in_shardings=(

@@ -1,0 +1,64 @@
+"""Checkpoint/resume of the streaming carry (aux subsystem, SURVEY.md §5).
+
+The reference has **no** in-run checkpointing — its only cross-run
+persistence is the results CSV append, and crash recovery is whole-run
+re-execution (``README.md:13``). Here the entire resumable state — model
+params, DDM statistics, carried batch_a, retrain flags, PRNG keys, stream
+offset — is one small pytree (a few KB per partition), saved as a flat
+``.npz`` plus JSON metadata. Loading requires a structurally-identical
+template pytree (the natural situation on resume: rebuild the detector with
+the same config, then restore). Typed PRNG-key arrays round-trip via their
+uint32 key data.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_key(leaf) -> bool:
+    return hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key)
+
+
+def _to_host(leaf) -> np.ndarray:
+    if _is_key(leaf):
+        return np.asarray(jax.random.key_data(leaf))
+    return np.asarray(leaf)
+
+
+def save_checkpoint(path: str, pytree, meta: dict | None = None) -> None:
+    leaves = jax.tree.leaves(pytree)
+    arrays = {f"leaf_{i}": _to_host(leaf) for i, leaf in enumerate(leaves)}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    with open(path, "wb") as fh:
+        np.savez(fh, **arrays)
+
+
+def load_checkpoint(path: str, template) -> tuple[object, dict]:
+    """Restore a pytree with the same structure/shapes/dtypes as ``template``."""
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, template has {len(t_leaves)}"
+        )
+    restored = []
+    for got, want in zip(leaves, t_leaves):
+        if _is_key(want):
+            restored.append(jax.random.wrap_key_data(jnp.asarray(got)))
+            continue
+        want_np = np.asarray(want)
+        if got.shape != want_np.shape:
+            raise ValueError(
+                f"checkpoint leaf shape {got.shape} != template {want_np.shape}"
+            )
+        restored.append(got.astype(want_np.dtype) if got.dtype != want_np.dtype else got)
+    return jax.tree.unflatten(treedef, restored), meta

@@ -1,0 +1,81 @@
+"""Grid harness + aggregation (reference C12-C15 semantics)."""
+
+import numpy as np
+
+from distributed_drift_detection_tpu import RunConfig
+from distributed_drift_detection_tpu.harness import (
+    aggregate,
+    grid_configs,
+    load_runs,
+    missing_configs,
+    run_grid,
+    speedup_table,
+    write_tables,
+)
+from distributed_drift_detection_tpu.results import read_results
+
+OUTDOOR = "/root/reference/outdoorStream.csv"
+
+
+def base_cfg(tmp_path):
+    return RunConfig(
+        dataset=OUTDOOR,
+        per_batch=50,
+        model="majority",
+        results_csv=str(tmp_path / "runs.csv"),
+    )
+
+
+def test_grid_idempotent_resume(tmp_path):
+    """The built-in crash recovery (C14): a second invocation runs nothing;
+    deleting rows re-runs exactly the missing trials."""
+    base = base_cfg(tmp_path)
+    n1 = run_grid(base, mults=[1], partitions=[1, 2], trials=2, progress=lambda *_: None)
+    assert n1 == 4
+    n2 = run_grid(base, mults=[1], partitions=[1, 2], trials=2, progress=lambda *_: None)
+    assert n2 == 0  # all present -> nothing re-run
+
+    # simulate a crash that lost the last trial
+    rows = read_results(base.results_csv)
+    with open(base.results_csv, "w", newline="") as fh:
+        import csv
+
+        w = csv.DictWriter(fh, fieldnames=rows[0].keys())
+        w.writeheader()
+        for r in rows[:-1]:
+            w.writerow(r)
+    cfgs = grid_configs(base, [1], [1, 2], trials=2)
+    assert len(missing_configs(cfgs)) == 1
+    n3 = run_grid(base, mults=[1], partitions=[1, 2], trials=2, progress=lambda *_: None)
+    assert n3 == 1
+
+
+def test_aggregate_and_tables(tmp_path):
+    base = base_cfg(tmp_path)
+    run_grid(base, mults=[1, 2], partitions=[1, 2], trials=2, progress=lambda *_: None)
+    df = load_runs(base.results_csv)
+    agg = aggregate(df)
+    # 2 mults x 2 partition counts, trial count = 2 each
+    assert len(agg) == 4
+    assert (agg["trials"] == 2).all()
+    assert np.isfinite(agg["mean_time"]).all()
+
+    sp = speedup_table(agg)
+    # speedup of the smallest instance count is 1.0 by construction
+    base_rows = sp[sp["Instances"] == 1]
+    np.testing.assert_allclose(base_rows["speedup"], 1.0)
+
+    paths = write_tables(base.results_csv, str(tmp_path))
+    for name in ("time_table.csv", "drift_delay.csv", "drift_delay_var.csv", "speedup_table.csv"):
+        assert name in paths
+        assert (tmp_path / name).exists()
+
+
+def test_render_all_figures(tmp_path):
+    from distributed_drift_detection_tpu.harness.plots import render_all
+
+    base = base_cfg(tmp_path)
+    run_grid(base, mults=[1], partitions=[1, 2], trials=1, progress=lambda *_: None)
+    artifacts = render_all(base.results_csv, str(tmp_path / "figs"))
+    assert "speedup.pdf" in artifacts
+    assert (tmp_path / "figs" / "delay_pct.pdf").exists()
