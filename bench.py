@@ -43,8 +43,7 @@ def main() -> None:
         mult_data=mult,
         partitions=partitions,
         per_batch=100,
-        model="linear",
-        fit_steps=16,
+        model="centroid",  # closed-form fit; the RF-equivalent flagship
         results_csv="",
     )
     stream, batches, runner, keys, mesh = prepare(cfg)

@@ -27,7 +27,7 @@ from typing import NamedTuple
 import jax
 import numpy as np
 
-from .config import RunConfig
+from .config import RunConfig, host_shuffle_seed
 from .engine.loop import FlagRows
 from .io.stream import StreamData, load_stream, stripe_partitions
 from .metrics import DelayMetrics, delay_metrics, result_row
@@ -53,7 +53,12 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         stream = load_stream(
             cfg.dataset, cfg.mult_data, seed=cfg.seed, standardize=cfg.standardize
         )
-    batches = stripe_partitions(stream, cfg.partitions, cfg.per_batch)
+    # Per-batch shuffle (C7 :187,190) is applied host-side at stripe time —
+    # each batch is visited once, so this is semantically identical to an
+    # in-loop shuffle but free on device (see io.stream.stripe_chunk).
+    batches = stripe_partitions(
+        stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
+    )
     spec = ModelSpec(stream.num_features, stream.num_classes)
     model = build_model(cfg.model, spec, cfg)
     n_dev = cfg.mesh_devices or len(jax.devices())
@@ -68,7 +73,7 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         model,
         cfg.ddm,
         mesh,
-        shuffle=cfg.shuffle_batches,
+        shuffle=False,  # already shuffled host-side above
         retrain_error_threshold=cfg.retrain_error_threshold,
     )
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)

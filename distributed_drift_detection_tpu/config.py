@@ -58,7 +58,7 @@ class RunConfig:
     # --- loop (reference C7, DDM_Process.py:162-213) ---
     per_batch: int = 100
     shuffle_batches: bool = True  # seeded analog of .sample(frac=1) at :187,190
-    model: str = "linear"  # 'majority' | 'linear' | 'mlp'
+    model: str = "linear"  # 'majority' | 'centroid' | 'linear' | 'mlp'
 
     # --- detector (reference C6) ---
     ddm: DDMParams = DDMParams()
@@ -100,3 +100,14 @@ class RunConfig:
 
 def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
     return dataclasses.replace(cfg, **kw)
+
+
+def host_shuffle_seed(cfg: RunConfig) -> int | None:
+    """The stripe-time shuffle seed a config implies (None = no shuffle).
+
+    Single source of truth shared by ``api.prepare`` and any chunked/soak
+    pipeline that wants bit-identical results to the one-shot path — pass
+    this as ``shuffle_seed`` to the feeder and run the engine with
+    ``shuffle=False``.
+    """
+    return cfg.seed + 0x5EED if cfg.shuffle_batches else None

@@ -48,10 +48,15 @@ class ChunkedDetector:
         ddm_params: DDMParams = DDMParams(),
         *,
         partitions: int,
-        shuffle: bool = True,
+        shuffle: bool = False,
         retrain_error_threshold: float | None = None,
         seed: int = 0,
     ):
+        # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
+        # (device-free and api.run-compatible) route is stripe-time shuffling:
+        # pass ``config.host_shuffle_seed(cfg)`` as the feeder's
+        # ``shuffle_seed`` and leave this False. In-jit shuffle exists for
+        # feeders that cannot pre-shuffle.
         self.model = model
         self.partitions = partitions
         step = make_partition_step(

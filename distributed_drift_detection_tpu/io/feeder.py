@@ -27,6 +27,7 @@ def chunk_stream_arrays(
     per_batch: int,
     chunk_batches: int,
     start_row: int = 0,
+    shuffle_seed: int | None = None,
 ) -> Iterator[Batches]:
     """Chunk an in-memory stream; rows are global positions + start_row."""
     n, f = X.shape
@@ -34,7 +35,7 @@ def chunk_stream_arrays(
     rows_per_chunk = p * b * cb
     for s in range(0, n, rows_per_chunk):
         e = min(s + rows_per_chunk, n)
-        yield stripe_chunk(X[s:e], y[s:e], s + start_row, p, b, cb)
+        yield stripe_chunk(X[s:e], y[s:e], s + start_row, p, b, cb, shuffle_seed)
 
 
 def generator_chunks(
@@ -43,6 +44,7 @@ def generator_chunks(
     partitions: int,
     per_batch: int,
     chunk_batches: int,
+    shuffle_seed: int | None = None,
 ) -> Iterator[Batches]:
     """Chunks from a chunk-exact generator ``chunk_fn(start, stop) -> (X, y)``
     (e.g. ``functools.partial(sea_chunk, seed, drift_every=...)`` adapted to
@@ -54,4 +56,4 @@ def generator_chunks(
     for s in range(0, total_rows, rows_per_chunk):
         e = min(s + rows_per_chunk, total_rows)
         X, y = chunk_fn(s, e)
-        yield stripe_chunk(X, y, s, p, b, cb)
+        yield stripe_chunk(X, y, s, p, b, cb, shuffle_seed)

@@ -106,20 +106,37 @@ def load_stream(
 
 
 def stripe_chunk(
-    X: np.ndarray, y: np.ndarray, start_row: int, partitions: int, per_batch: int, nb: int
+    X: np.ndarray,
+    y: np.ndarray,
+    start_row: int,
+    partitions: int,
+    per_batch: int,
+    nb: int,
+    shuffle_seed: int | None = None,
 ) -> Batches:
     """Pad + row-stripe one contiguous span of the stream into ``[P, NB, B]``.
 
     Row ``start_row + i`` goes to partition ``(start_row + i) % P`` at the
-    next slot (C8 ``:225`` placement); ``start_row`` must be a multiple of P
-    so striping is chunking-invariant. The single implementation shared by
-    the one-shot path (:func:`stripe_partitions`) and the chunk feeder
+    next slot (C8 ``:225`` placement); ``start_row`` must be a multiple of
+    P·B so striping is chunking-invariant. The single implementation shared
+    by the one-shot path (:func:`stripe_partitions`) and the chunk feeder
     (``io.feeder``) — their bit-exact agreement is a correctness contract
     (see ``tests/test_chunked.py``).
+
+    ``shuffle_seed`` applies the reference's per-microbatch shuffle
+    (``batch.sample(frac=1)``, ``DDM_Process.py:187,190``) **on the host at
+    stripe time** instead of inside the compiled loop: each batch is visited
+    exactly once, so a pre-shuffle is semantically identical to the engine's
+    in-jit shuffle while costing zero device time. Chunking-invariant
+    (counter-based PRNG keyed on the absolute batch slot).
     """
     n = len(y)
     p, b = partitions, per_batch
     padded = p * nb * b
+    assert shuffle_seed is None or start_row % (p * b) == 0, (
+        "stripe-time shuffle needs start_row aligned to partitions*per_batch "
+        "(all regular chunk boundaries are); pass shuffle_seed=None otherwise"
+    )
 
     def pad(arr, fill):
         out = np.full((padded, *arr.shape[1:]), fill, arr.dtype)
@@ -129,10 +146,29 @@ def stripe_chunk(
     rows = start_row + np.arange(padded, dtype=np.int64)
     valid = np.arange(padded) < n
 
-    def stripe(arr):
-        return np.ascontiguousarray(
-            arr.reshape(nb * b, p, *arr.shape[1:]).swapaxes(0, 1)
-        ).reshape(p, nb, b, *arr.shape[1:])
+    if shuffle_seed is None:
+        def stripe(arr):
+            # padded position i → partition i % P, slot i // P  (C8 :225)
+            return np.ascontiguousarray(
+                arr.reshape(nb * b, p, *arr.shape[1:]).swapaxes(0, 1)
+            ).reshape(p, nb, b, *arr.shape[1:])
+    else:
+        # Per-batch permutation keyed on the absolute batch slot (slot-major
+        # id ``abs_slot * P + partition`` is contiguous within a chunk),
+        # composed with the stripe into one gather: striped[p, s, j] =
+        # padded[(s*B + j)*P + p], so the shuffled element is
+        # padded[(s*B + perm[p, s, j])*P + p].
+        from ..utils.prng import row_uniforms
+
+        start_slot = start_row // (p * b)
+        u = row_uniforms(shuffle_seed, start_slot * p, nb * p, b, stream_id=3)
+        perms = np.argsort(u.reshape(nb, p, b), axis=-1).swapaxes(0, 1)
+        slot = np.arange(nb, dtype=np.int64)[None, :, None]
+        part = np.arange(p, dtype=np.int64)[:, None, None]
+        gather = (slot * b + perms) * p + part  # [P, NB, B]
+
+        def stripe(arr):
+            return arr[gather]
 
     return Batches(
         X=stripe(pad(np.asarray(X, np.float32), 0.0)),
@@ -142,15 +178,22 @@ def stripe_chunk(
     )
 
 
-def stripe_partitions(stream: StreamData, partitions: int, per_batch: int) -> Batches:
+def stripe_partitions(
+    stream: StreamData,
+    partitions: int,
+    per_batch: int,
+    shuffle_seed: int | None = None,
+) -> Batches:
     """Row-stripe the whole stream over P partitions (one-shot path).
 
     Returns :class:`Batches` with leading partition axis: ``X [P, NB, B, F]``,
     ``y/rows/valid [P, NB, B]``. ``rows`` holds global stream positions so the
     delay metric (global position % concept length) works per the reference's
-    intent.
+    intent. ``shuffle_seed``: see :func:`stripe_chunk`.
     """
     n = stream.num_rows
     per_part = -(-n // partitions)  # ceil: partition sizes differ by ≤ 1 (C8)
     nb = -(-per_part // per_batch)
-    return stripe_chunk(stream.X, stream.y, 0, partitions, per_batch, nb)
+    return stripe_chunk(
+        stream.X, stream.y, 0, partitions, per_batch, nb, shuffle_seed
+    )

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils.prng import row_uniforms as _row_uniforms
 from .stream import StreamData
 
 
@@ -51,17 +52,6 @@ def planted_prototypes(
 
 # SEA concept thresholds (Street & Kim 2001): label = f0 + f1 <= theta.
 _SEA_THETAS = (8.0, 9.0, 7.0, 9.5)
-
-
-def _row_uniforms(seed: int, start: int, n: int, per_row: int, stream_id: int):
-    """``[n, per_row]`` uniforms that depend only on (seed, stream_id, row):
-    counter-based Philox advanced to ``start * per_row``, so any chunking of
-    the stream reproduces identical rows — the property the soak feeder
-    relies on."""
-    width = -4 * (-per_row // 4)  # one Philox advance unit = one 4x64-bit
-    bitgen = np.random.Philox(key=np.uint64(seed) ^ (np.uint64(stream_id) << 32))
-    bitgen.advance(int(start) * (width // 4))  # block = 4 f64 draws
-    return np.random.Generator(bitgen).random((n, width))[:, :per_row]
 
 
 def sea_chunk(seed: int, start: int, stop: int, drift_every: int, noise: float = 0.0):

@@ -1,10 +1,17 @@
 from .base import Model, ModelSpec
-from .classifiers import build_model, make_linear, make_majority, make_mlp
+from .classifiers import (
+    build_model,
+    make_centroid,
+    make_linear,
+    make_majority,
+    make_mlp,
+)
 
 __all__ = [
     "Model",
     "ModelSpec",
     "build_model",
+    "make_centroid",
     "make_linear",
     "make_majority",
     "make_mlp",

@@ -1,0 +1,77 @@
+"""Classifier-family tests (reference C4/C5 replacements)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+SPEC = ModelSpec(num_features=8, num_classes=5)
+
+
+def separable_batch(rng, n=100, classes=5, f=8):
+    protos = rng.normal(size=(classes, f)).astype(np.float32) * 3
+    y = rng.integers(0, classes, n).astype(np.int32)
+    X = protos[y] + 0.05 * rng.normal(size=(n, f)).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("name", ["majority", "centroid", "linear", "mlp"])
+def test_fit_predict_roundtrip(name):
+    rng = np.random.default_rng(0)
+    model = build_model(name, SPEC)
+    X, y = separable_batch(rng)
+    w = jnp.ones(X.shape[0], jnp.float32)
+    params = jax.jit(model.fit)(jax.random.key(0), X, y, w)
+    preds = jax.jit(model.predict)(params, X)
+    if name == "majority":
+        # majority predicts the single modal class
+        assert (preds == jnp.bincount(y, length=5).argmax()).all()
+    else:
+        err = float((preds != y).mean())
+        assert err < 0.05, f"{name} train error {err}"
+
+
+@pytest.mark.parametrize("name", ["centroid", "linear", "mlp"])
+def test_generalizes_to_same_distribution(name):
+    rng = np.random.default_rng(1)
+    protos = rng.normal(size=(5, 8)).astype(np.float32) * 3
+    ytr = rng.integers(0, 5, 200).astype(np.int32)
+    Xtr = protos[ytr] + 0.05 * rng.normal(size=(200, 8)).astype(np.float32)
+    yte = rng.integers(0, 5, 200).astype(np.int32)
+    Xte = protos[yte] + 0.05 * rng.normal(size=(200, 8)).astype(np.float32)
+    model = build_model(name, SPEC)
+    params = model.fit(
+        jax.random.key(1), jnp.asarray(Xtr), jnp.asarray(ytr), jnp.ones(200)
+    )
+    err = float((model.predict(params, jnp.asarray(Xte)) != jnp.asarray(yte)).mean())
+    assert err < 0.05
+
+
+def test_weight_mask_excludes_padding():
+    """Padded rows must not influence the fit (centroid is exactly linear in
+    weights, so a poisoned padding row flips the result if unmasked)."""
+    model = build_model("centroid", SPEC)
+    rng = np.random.default_rng(2)
+    X, y = separable_batch(rng, n=50)
+    X_pad = jnp.concatenate([X, 1e6 * jnp.ones((10, 8))])
+    y_pad = jnp.concatenate([y, jnp.zeros(10, jnp.int32)])
+    w = jnp.concatenate([jnp.ones(50), jnp.zeros(10)])
+    p_clean = model.fit(jax.random.key(0), X, y, jnp.ones(50))
+    p_mask = model.fit(jax.random.key(0), X_pad, y_pad, w)
+    np.testing.assert_allclose(
+        np.asarray(p_clean.centroids), np.asarray(p_mask.centroids), rtol=1e-6
+    )
+
+
+def test_centroid_absent_class_never_predicted():
+    model = build_model("centroid", SPEC)
+    X = jnp.zeros((20, 8))
+    y = jnp.full(20, 3, jnp.int32)  # only class 3 present
+    params = model.fit(jax.random.key(0), X, y, jnp.ones(20))
+    rng = np.random.default_rng(3)
+    Xq = jnp.asarray(rng.normal(size=(100, 8)).astype(np.float32))
+    preds = model.predict(params, Xq)
+    assert (preds == 3).all()
