@@ -1,0 +1,139 @@
+// Native data-plane for distributed_drift_detection_tpu.
+//
+// The reference's data plane is Spark's JVM + Arrow serialization
+// (DDM_Process.py:222, pandas_udf boundary); its CSV ingest is pandas. Here
+// the host-side ingest path is a small C++ library exposed over a C ABI and
+// bound with ctypes (io/native.py): a multithreaded CSV -> float32 parser
+// used to feed streams to the device at memory speed instead of Python
+// parsing speed. Compute stays in XLA; this is host runtime only.
+//
+// Handle-based API so the file is read and line-indexed exactly once:
+//   h = ddd_csv_open(path); ddd_csv_rows(h); ddd_csv_cols(h);
+//   ddd_csv_read(h, out);   ddd_csv_close(h);
+//
+// Parsing is strict: any field std::from_chars cannot fully consume (after
+// an optional leading '+') fails the row, ddd_csv_read returns the count of
+// bad rows as a negative number, and the Python binding falls back to the
+// NumPy path (which raises) — malformed data never silently becomes zeros.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
+
+#include <algorithm>
+#include <atomic>
+#include <charconv>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Csv {
+  std::string data;
+  std::vector<const char*> starts;  // body line starts
+  int64_t rows = 0;
+  int64_t cols = 0;
+};
+
+// Parse one CSV line of `cols` floats into out[0..cols). Strict: returns
+// false on any malformed/missing/extra field.
+bool parse_line(const char* p, const char* end, float* out, int64_t cols) {
+  int64_t c = 0;
+  while (c < cols) {
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (p < end && *p == '+') ++p;  // from_chars rejects leading '+'
+    double v = 0.0;
+    auto [next, ec] = std::from_chars(p, end, v);
+    if (ec != std::errc() || next == p) return false;
+    out[c++] = static_cast<float>(v);
+    p = next;
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (c < cols) {
+      if (p >= end || *p != ',') return false;
+      ++p;
+    }
+  }
+  return p == end;  // trailing garbage fails the row
+}
+
+unsigned num_threads() {
+  unsigned t = std::thread::hardware_concurrency();
+  return t ? t : 4;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or null on IO/format error.
+void* ddd_csv_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* csv = new Csv();
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  csv->data.resize(static_cast<size_t>(n));
+  bool ok = std::fread(csv->data.data(), 1, csv->data.size(), f) ==
+            csv->data.size();
+  std::fclose(f);
+  const char* base = csv->data.data();
+  const char* end = base + csv->data.size();
+  const char* nl =
+      ok && n > 0
+          ? static_cast<const char*>(memchr(base, '\n', csv->data.size()))
+          : nullptr;
+  if (!nl) {
+    delete csv;
+    return nullptr;
+  }
+  csv->cols = 1 + std::count(base, nl, ',');
+  for (const char* q = nl + 1; q < end;) {
+    const char* e = static_cast<const char*>(memchr(q, '\n', end - q));
+    const char* line_end = e ? e : end;
+    if (line_end > q && *(line_end - 1) == '\r') --line_end;
+    if (line_end > q) csv->starts.push_back(q);
+    if (!e) break;
+    q = e + 1;
+  }
+  csv->rows = static_cast<int64_t>(csv->starts.size());
+  return csv;
+}
+
+int64_t ddd_csv_rows(void* handle) { return static_cast<Csv*>(handle)->rows; }
+int64_t ddd_csv_cols(void* handle) { return static_cast<Csv*>(handle)->cols; }
+
+// Parse all rows into out[rows*cols] (row-major f32). Returns 0 on success,
+// or -(number of malformed rows).
+int64_t ddd_csv_read(void* handle, float* out) {
+  Csv* csv = static_cast<Csv*>(handle);
+  const char* end = csv->data.data() + csv->data.size();
+  const int64_t n = csv->rows;
+  const int64_t cols = csv->cols;
+
+  unsigned T = num_threads();
+  std::atomic<int64_t> bad{0};
+  std::vector<std::thread> threads;
+  int64_t per = (n + T - 1) / T;
+  for (unsigned t = 0; t < T; ++t) {
+    int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back([&, lo, hi] {
+      for (int64_t i = lo; i < hi; ++i) {
+        const char* s = csv->starts[static_cast<size_t>(i)];
+        const char* e = static_cast<const char*>(memchr(s, '\n', end - s));
+        if (!e) e = end;
+        if (e > s && *(e - 1) == '\r') --e;
+        if (!parse_line(s, e, out + i * cols, cols)) bad.fetch_add(1);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  return -bad.load();
+}
+
+void ddd_csv_close(void* handle) { delete static_cast<Csv*>(handle); }
+
+}  // extern "C"

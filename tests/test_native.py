@@ -1,0 +1,43 @@
+"""Native C++ ingest library: parity with the NumPy path."""
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.io import load_csv
+from distributed_drift_detection_tpu.io.native import load_csv_native, native_available
+
+OUTDOOR = "/root/reference/outdoorStream.csv"
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable (no toolchain)"
+)
+
+
+@needs_native
+def test_native_matches_numpy():
+    raw_native = load_csv_native(OUTDOOR)
+    raw_numpy = np.loadtxt(OUTDOOR, delimiter=",", skiprows=1, dtype=np.float32)
+    assert raw_native.shape == raw_numpy.shape
+    np.testing.assert_allclose(raw_native, raw_numpy, rtol=1e-6)
+
+
+@needs_native
+def test_native_handles_crlf_and_no_trailing_newline(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_bytes(b"a,b,target\r\n1.5,2.5,0\r\n3.25,-4.5,1")
+    raw = load_csv_native(str(p))
+    np.testing.assert_allclose(raw, [[1.5, 2.5, 0.0], [3.25, -4.5, 1.0]])
+
+
+def test_load_csv_uses_some_path():
+    """load_csv works regardless of which backend parsed (native or numpy)."""
+    X, y = load_csv(OUTDOOR)
+    assert X.shape == (4000, 21)
+    assert y.shape == (4000,)
+    assert y.min() >= 0
+
+
+def test_native_missing_file_returns_none():
+    if not native_available():
+        pytest.skip("native library unavailable")
+    assert load_csv_native("/nonexistent/file.csv") is None
