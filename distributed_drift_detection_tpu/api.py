@@ -29,7 +29,12 @@ import numpy as np
 
 from .config import RunConfig, host_shuffle_seed
 from .engine.loop import FlagRows
-from .io.stream import StreamData, load_stream, stripe_partitions
+from .io.stream import (
+    StreamData,
+    load_stream,
+    stripe_partitions,
+    stripe_partitions_indexed,
+)
 from .metrics import DelayMetrics, delay_metrics, result_row
 from .models import ModelSpec, build_model
 from .parallel.mesh import make_mesh, make_mesh_runner, shard_batches
@@ -56,7 +61,12 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # Per-batch shuffle (C7 :187,190) is applied host-side at stripe time —
     # each batch is visited once, so this is semantically identical to an
     # in-loop shuffle but free on device (see io.stream.stripe_chunk).
-    batches = stripe_partitions(
+    # Streams synthesized by duplication keep a compressed (row table + index
+    # planes) form; ship that across the host→device link instead of the
+    # materialized stream — identical flags, ~14× less transfer at mult=512.
+    indexed = stream.src is not None and cfg.window > 1
+    striper = stripe_partitions_indexed if indexed else stripe_partitions
+    batches = striper(
         stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
     )
     spec = ModelSpec(stream.num_features, stream.num_classes)
@@ -75,6 +85,8 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         mesh,
         shuffle=False,  # already shuffled host-side above
         retrain_error_threshold=cfg.retrain_error_threshold,
+        window=cfg.window,
+        indexed=indexed,
     )
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
     return PreparedRun(stream, batches, runner, keys, mesh)

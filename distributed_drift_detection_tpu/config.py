@@ -75,6 +75,18 @@ class RunConfig:
     partitions: int = 8  # reference INSTANCES: row-striped stream partitions
     mesh_devices: int = 0  # 0 = all visible devices
 
+    # --- execution strategy ---
+    # Speculative window width (engine.window): microbatches processed per
+    # sequential step between drift checks. 1 = faithful batch-per-step scan;
+    # >1 commits up to the first in-window change and replays the rest —
+    # identical flags for deterministic-fit models (majority/centroid/linear),
+    # ~window× fewer sequential steps. 16 balances speculation waste
+    # (~1 window per drift) vs step size. Caveat: the key-consuming 'mlp' fit
+    # draws its init keys per *window*, not per batch, so its flags are
+    # seed-equivalent but not bit-equal across different window values — pin
+    # window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
+    window: int = 16
+
     # --- model hyper-parameters (TPU-native replacements for RandomForest) ---
     fit_steps: int = 32
     learning_rate: float = 0.5

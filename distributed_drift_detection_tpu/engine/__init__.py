@@ -1,11 +1,21 @@
 from .chunked import ChunkedDetector
-from .loop import Batches, FlagRows, LoopCarry, make_partition_runner, make_partition_step
+from .loop import (
+    Batches,
+    FlagRows,
+    IndexedBatches,
+    LoopCarry,
+    make_partition_runner,
+    make_partition_step,
+)
+from .window import make_window_runner
 
 __all__ = [
     "Batches",
     "ChunkedDetector",
     "FlagRows",
+    "IndexedBatches",
     "LoopCarry",
     "make_partition_runner",
     "make_partition_step",
+    "make_window_runner",
 ]

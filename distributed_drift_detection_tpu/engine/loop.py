@@ -47,6 +47,28 @@ class Batches(NamedTuple):
     valid: jax.Array  # [NB, B] bool (False = padding)
 
 
+class IndexedBatches(NamedTuple):
+    """Compressed stream: microbatch grid of *indices into a row table*.
+
+    The reference's volume scaling duplicates a small CSV ``MULT_DATA`` times
+    before shipping the whole dataframe to the cluster (``DDM_Process.py:
+    44-49,222`` — hence its 512 MB RPC limit). On TPU the host→device link is
+    the scarce resource, so the framework ships the information content
+    instead: the deduplicated row table (replicated, a few hundred KB) plus
+    int16/int32 index planes (~14× smaller than the materialized stream at
+    mult=512), and gathers rows on device inside the compiled loop. Identical
+    stream semantics — every row still flows through the detector.
+
+    ``X[s] ≡ base_X[idx[s]]``, ``y[s] ≡ base_y[idx[s]]``.
+    """
+
+    base_X: jax.Array  # [T, F] f32 row table (replicated across the mesh)
+    base_y: jax.Array  # [T] i32
+    idx: jax.Array  # [NB, B] i16/i32 row-table index (leading [P,..] sharded)
+    rows: jax.Array  # [NB, B] i32 global stream positions
+    valid: jax.Array  # [NB, B] bool (False = padding)
+
+
 class FlagRows(NamedTuple):
     """Per-batch detection flags — reference output schema (−1 sentinels),
     plus ``forced_retrain`` marking fallback retrains (see

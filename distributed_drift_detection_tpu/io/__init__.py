@@ -1,5 +1,13 @@
 from .feeder import chunk_stream_arrays, generator_chunks
-from .stream import StreamData, load_csv, load_stream, stripe_partitions, synthesize_stream
+from .stream import (
+    StreamData,
+    load_csv,
+    load_stream,
+    materialize_batches,
+    stripe_partitions,
+    stripe_partitions_indexed,
+    synthesize_stream,
+)
 from .synth import (
     as_stream,
     hyperplane_chunk,
@@ -15,7 +23,9 @@ __all__ = [
     "StreamData",
     "load_csv",
     "load_stream",
+    "materialize_batches",
     "stripe_partitions",
+    "stripe_partitions_indexed",
     "synthesize_stream",
     "as_stream",
     "hyperplane_chunk",

@@ -32,24 +32,61 @@ from typing import NamedTuple
 
 import numpy as np
 
-from ..engine.loop import Batches
+from ..engine.loop import Batches, IndexedBatches
 
 
-class StreamData(NamedTuple):
-    """A prepared drift stream (host-side, numpy)."""
+class StreamData:
+    """A prepared drift stream (host-side, numpy).
 
-    X: np.ndarray  # [N, F] f32
-    y: np.ndarray  # [N] i32, labels re-indexed to 0..C-1
-    num_classes: int
-    dist_between_changes: int  # rows // classes (C2, :55)
+    When the stream was synthesized by integer duplication of a row table
+    (``mult_data >= 1``), only the compressed form is stored:
+    ``base_X``/``base_y`` (the table) plus ``src`` (stream position → table
+    row), with ``X == base_X[src]``, ``y == base_y[src]``. The striper uses
+    it to build :class:`IndexedBatches` so only the table + index planes
+    cross the host→device link (see ``engine.loop.IndexedBatches``); the
+    dense ``X``/``y`` views materialize **lazily on first access** — the
+    compressed execution path never pays the multi-GB expansion the
+    reference performs eagerly (``DDM_Process.py:44-49``).
+    """
+
+    def __init__(
+        self,
+        X: np.ndarray | None = None,  # [N, F] f32
+        y: np.ndarray | None = None,  # [N] i32, labels re-indexed to 0..C-1
+        num_classes: int = 0,
+        dist_between_changes: int = 0,  # rows // classes (C2, :55)
+        base_X: np.ndarray | None = None,  # [T, F] f32 deduplicated row table
+        base_y: np.ndarray | None = None,  # [T] i32
+        src: np.ndarray | None = None,  # [N] i32: stream position → table row
+    ):
+        assert (X is not None and y is not None) or src is not None
+        self._X = X
+        self._y = y
+        self.num_classes = num_classes
+        self.dist_between_changes = dist_between_changes
+        self.base_X = base_X
+        self.base_y = base_y
+        self.src = src
+
+    @property
+    def X(self) -> np.ndarray:
+        if self._X is None:
+            self._X = self.base_X[self.src]
+        return self._X
+
+    @property
+    def y(self) -> np.ndarray:
+        if self._y is None:
+            self._y = self.base_y[self.src]
+        return self._y
 
     @property
     def num_rows(self) -> int:
-        return len(self.y)
+        return len(self.src) if self.src is not None else len(self._y)
 
     @property
     def num_features(self) -> int:
-        return self.X.shape[1]
+        return (self.base_X if self.base_X is not None else self._X).shape[1]
 
 
 def load_csv(path: str, target_column: str = "target") -> tuple[np.ndarray, np.ndarray]:
@@ -79,31 +116,51 @@ def synthesize_stream(
     seed: int = 0,
     standardize: bool = True,
 ) -> StreamData:
-    """Volume-scale, shuffle, sort-by-target — the C2 semantics, seeded."""
+    """Volume-scale, shuffle, sort-by-target — the C2 semantics, seeded.
+
+    ``mult_data >= 1`` composes the duplicate/shuffle/sort as **index
+    operations** over the untouched row table: the stream is
+    ``base_X[src]`` for a [N] index vector ``src``, and both forms are
+    returned (compressed striping path). Standardization statistics are
+    computed on the table — the duplicated stream is ``reps`` exact copies
+    of it, so the moments are identical. ``mult_data < 1`` subsamples rows
+    (and possibly classes), so it materializes directly.
+    """
     rng = np.random.default_rng(seed)
     n = len(y)
+
+    def _standardize(A):
+        A = np.ascontiguousarray(A, np.float32)
+        if not standardize:
+            return A
+        mu = A.mean(axis=0)
+        sd = A.std(axis=0)
+        return (A - mu) / np.where(sd > 0, sd, 1.0)
+
     if mult_data < 1.0:
         take = rng.permutation(n)[: max(1, int(round(n * mult_data)))]
         X, y = X[take], y[take]
-    else:
-        reps = int(mult_data)
-        idx = rng.permutation(n * reps) % n
-        X, y = X[idx], y[idx]
+        order = np.argsort(y, kind="stable")  # :51, stable like pandas
+        X, y = X[order], y[order]
+        classes, y_idx = np.unique(y, return_inverse=True)
+        return StreamData(
+            X=_standardize(X),
+            y=y_idx.astype(np.int32),
+            num_classes=len(classes),
+            dist_between_changes=len(y) // len(classes),
+        )
 
-    order = np.argsort(y, kind="stable")  # :51, stable like pandas sort_values
-    X, y = X[order], y[order]
-
-    classes, y_idx = np.unique(y, return_inverse=True)
-    if standardize:
-        mu = X.mean(axis=0)
-        sd = X.std(axis=0)
-        X = (X - mu) / np.where(sd > 0, sd, 1.0)
-
+    reps = int(mult_data)
+    sel = rng.permutation(n * reps) % n  # each table row exactly `reps` times
+    order = np.argsort(y[sel], kind="stable")  # :51
+    src = sel[order].astype(np.int32)
+    classes, y_base = np.unique(y, return_inverse=True)
     return StreamData(
-        X=np.ascontiguousarray(X, np.float32),
-        y=y_idx.astype(np.int32),
         num_classes=len(classes),
-        dist_between_changes=len(y) // len(classes),
+        dist_between_changes=len(src) // len(classes),
+        base_X=_standardize(X),
+        base_y=y_base.astype(np.int32),
+        src=src,
     )
 
 
@@ -141,50 +198,52 @@ def stripe_chunk(
     """
     n = len(y)
     p, b = partitions, per_batch
-    padded = p * nb * b
+    gmap, rows, valid = _stripe_maps(n, start_row, p, b, nb, shuffle_seed)
+    return Batches(
+        X=_pad(np.asarray(X, np.float32), p * nb * b, 0.0)[gmap],
+        y=_pad(np.asarray(y, np.int32), p * nb * b, 0)[gmap],
+        rows=rows,
+        valid=valid,
+    )
+
+
+def _pad(arr: np.ndarray, padded: int, fill) -> np.ndarray:
+    out = np.full((padded, *arr.shape[1:]), fill, arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def _stripe_maps(
+    n: int, start_row: int, p: int, b: int, nb: int, shuffle_seed: int | None
+):
+    """The stripe as a gather: ``striped[p, s, j] = padded[gmap[p, s, j]]``.
+
+    Padded position ``i`` → partition ``i % P``, slot ``i // P`` (C8 ``:225``),
+    so ``gmap[p, s, j] = (s·B + j)·P + p`` — with ``j`` optionally sent
+    through the per-batch shuffle permutation (``DDM_Process.py:187,190``,
+    seeded; keyed on the absolute batch slot so chunking is invariant).
+    Returns ``(gmap, rows, valid)``, each ``[P, NB, B]``; ``rows`` are global
+    stream positions, ``valid`` masks padding.
+    """
     assert shuffle_seed is None or start_row % (p * b) == 0, (
         "stripe-time shuffle needs start_row aligned to partitions*per_batch "
         "(all regular chunk boundaries are); pass shuffle_seed=None otherwise"
     )
-
-    def pad(arr, fill):
-        out = np.full((padded, *arr.shape[1:]), fill, arr.dtype)
-        out[:n] = arr
-        return out
-
-    rows = start_row + np.arange(padded, dtype=np.int64)
-    valid = np.arange(padded) < n
-
+    slot = np.arange(nb, dtype=np.int64)[None, :, None]
+    part = np.arange(p, dtype=np.int64)[:, None, None]
     if shuffle_seed is None:
-        def stripe(arr):
-            # padded position i → partition i % P, slot i // P  (C8 :225)
-            return np.ascontiguousarray(
-                arr.reshape(nb * b, p, *arr.shape[1:]).swapaxes(0, 1)
-            ).reshape(p, nb, b, *arr.shape[1:])
+        j = np.arange(b, dtype=np.int64)[None, None, :]
+        gmap = (slot * b + j) * p + part  # [P, NB, B]
     else:
-        # Per-batch permutation keyed on the absolute batch slot (slot-major
-        # id ``abs_slot * P + partition`` is contiguous within a chunk),
-        # composed with the stripe into one gather: striped[p, s, j] =
-        # padded[(s*B + j)*P + p], so the shuffled element is
-        # padded[(s*B + perm[p, s, j])*P + p].
         from ..utils.prng import row_uniforms
 
         start_slot = start_row // (p * b)
         u = row_uniforms(shuffle_seed, start_slot * p, nb * p, b, stream_id=3)
         perms = np.argsort(u.reshape(nb, p, b), axis=-1).swapaxes(0, 1)
-        slot = np.arange(nb, dtype=np.int64)[None, :, None]
-        part = np.arange(p, dtype=np.int64)[:, None, None]
-        gather = (slot * b + perms) * p + part  # [P, NB, B]
-
-        def stripe(arr):
-            return arr[gather]
-
-    return Batches(
-        X=stripe(pad(np.asarray(X, np.float32), 0.0)),
-        y=stripe(pad(np.asarray(y, np.int32), 0)),
-        rows=stripe(rows.astype(np.int32)),
-        valid=stripe(valid),
-    )
+        gmap = (slot * b + perms) * p + part
+    rows = (start_row + gmap).astype(np.int32)
+    valid = gmap < n
+    return gmap, rows, valid
 
 
 def stripe_partitions(
@@ -205,4 +264,58 @@ def stripe_partitions(
     nb = -(-per_part // per_batch)
     return stripe_chunk(
         stream.X, stream.y, 0, partitions, per_batch, nb, shuffle_seed
+    )
+
+
+def stripe_partitions_indexed(
+    stream: StreamData,
+    partitions: int,
+    per_batch: int,
+    shuffle_seed: int | None = None,
+) -> IndexedBatches:
+    """Compressed variant of :func:`stripe_partitions`.
+
+    Same placement, same shuffle, same ``rows``/``valid`` planes — but the
+    data plane is ``idx`` (stream's ``src`` map composed with the stripe
+    gather) over the deduplicated row table, int16 when the table allows it.
+    ``engine.window`` gathers ``X``/``y`` on device;
+    ``materialize_batches`` reproduces the exact :class:`Batches` for parity
+    checks. Requires a stream synthesized with ``mult_data >= 1``.
+    """
+    if stream.src is None:
+        raise ValueError(
+            "stream has no compressed form (subsampled or hand-built); "
+            "use stripe_partitions"
+        )
+    n = stream.num_rows
+    per_part = -(-n // partitions)
+    nb = -(-per_part // per_batch)
+    gmap, rows, valid = _stripe_maps(
+        n, 0, partitions, per_batch, nb, shuffle_seed
+    )
+    idx = _pad(stream.src.astype(np.int64), partitions * nb * per_batch, 0)[gmap]
+    dt = np.int16 if len(stream.base_y) <= np.iinfo(np.int16).max else np.int32
+    return IndexedBatches(
+        base_X=stream.base_X,
+        base_y=stream.base_y,
+        idx=idx.astype(dt),
+        rows=rows,
+        valid=valid,
+    )
+
+
+def materialize_batches(batches: IndexedBatches) -> Batches:
+    """Expand a compressed grid to the equivalent :class:`Batches` (host).
+
+    Padding slots (``valid == False``) carry ``idx = 0``; mask them back to
+    the dense striper's fill values (0.0 / 0) so the result is bit-identical
+    to :func:`stripe_partitions` even on ragged grids.
+    """
+    idx = np.asarray(batches.idx).astype(np.int64)
+    valid = np.asarray(batches.valid)
+    return Batches(
+        X=np.where(valid[..., None], np.asarray(batches.base_X)[idx], np.float32(0)),
+        y=np.where(valid, np.asarray(batches.base_y)[idx], 0),
+        rows=batches.rows,
+        valid=batches.valid,
     )

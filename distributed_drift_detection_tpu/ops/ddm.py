@@ -69,6 +69,13 @@ class DDMBatchResult(NamedTuple):
     first_change: jax.Array  # i32: index in batch of first change, or −1
 
 
+class DDMWindowResult(NamedTuple):
+    """Per-batch detection summary over a window of W microbatches."""
+
+    first_warning: jax.Array  # i32 [W]: index within batch, or −1
+    first_change: jax.Array  # i32 [W]: index within batch, or −1
+
+
 def ddm_init() -> DDMState:
     """Fresh detector state (equivalent to a new skmultiflow ``DDM``)."""
     f = jnp.float32
@@ -135,6 +142,61 @@ def _run_min(ps_masked: jax.Array, p: jax.Array, s: jax.Array):
     return lax.associative_scan(combine, (ps_masked, p, s))
 
 
+def _prefix_masks(
+    state: DDMState, errs: jax.Array, valid: jax.Array, params: DDMParams
+):
+    """Shared core: per-element prefix statistics + warning/change masks.
+
+    ``errs``/``valid`` are flat ``[N]``; returns ``(end_state, warning[N],
+    change[N])`` where the masks hold at each prefix position and
+    ``end_state`` is the detector state after absorbing every valid element.
+    """
+    v = valid.astype(jnp.int32)
+    cnt = state.count + jnp.cumsum(v)  # i32 [N]
+    esum = state.err_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
+    cnt_f = jnp.maximum(cnt, 1).astype(jnp.float32)
+    p = esum / cnt_f
+    s = jnp.sqrt(jnp.clip(p * (1.0 - p), 0.0) / cnt_f)
+    ps = p + s
+
+    check = valid & ((cnt + 1) >= params.min_num_instances)
+    ps_masked = jnp.where(check, ps, _INF)
+    run_ps, run_p, run_s = _run_min(ps_masked, p, s)
+
+    # Merge the carried minima (strictly earlier than every batch element, so
+    # a batch minimum that ties it wins — same `<=` rule).
+    use_run = run_ps <= state.ps_min
+    ps_min = jnp.where(use_run, run_ps, state.ps_min)
+    p_min = jnp.where(use_run, run_p, state.p_min)
+    s_min = jnp.where(use_run, run_s, state.s_min)
+
+    change = check & (ps > p_min + params.out_control_level * s_min)
+    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
+
+    end_state = DDMState(
+        count=cnt[-1],
+        err_sum=esum[-1],
+        ps_min=ps_min[-1],
+        p_min=p_min[-1],
+        s_min=s_min[-1],
+    )
+    return end_state, warning, change
+
+
+def _first_true(mask: jax.Array, limit: jax.Array | None = None):
+    """Index of the first True along the last axis, −1 when none.
+
+    ``limit`` (optional, same leading shape) restricts the search to
+    ``index <= limit`` (the reference's early-break visibility window).
+    """
+    if limit is not None:
+        idx = jnp.arange(mask.shape[-1], dtype=jnp.int32)
+        mask = mask & (idx <= limit[..., None])
+    has = jnp.any(mask, axis=-1)
+    pos = jnp.argmax(mask, axis=-1).astype(jnp.int32)
+    return jnp.where(has, pos, jnp.int32(-1))
+
+
 def ddm_batch(
     state: DDMState,
     errs: jax.Array,
@@ -160,45 +222,48 @@ def ddm_batch(
       ``(state_after_full_batch, DDMBatchResult)``.
     """
     b = errs.shape[0]
-    v = valid.astype(jnp.int32)
-    cnt = state.count + jnp.cumsum(v)  # i32 [B]
-    esum = state.err_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
-    cnt_f = jnp.maximum(cnt, 1).astype(jnp.float32)
-    p = esum / cnt_f
-    s = jnp.sqrt(jnp.clip(p * (1.0 - p), 0.0) / cnt_f)
-    ps = p + s
+    new_state, warning, change = _prefix_masks(state, errs, valid, params)
 
-    check = valid & ((cnt + 1) >= params.min_num_instances)
-    ps_masked = jnp.where(check, ps, _INF)
-    run_ps, run_p, run_s = _run_min(ps_masked, p, s)
-
-    # Merge the carried minima (strictly earlier than every batch element, so
-    # a batch minimum that ties it wins — same `<=` rule).
-    use_run = run_ps <= state.ps_min
-    ps_min = jnp.where(use_run, run_ps, state.ps_min)
-    p_min = jnp.where(use_run, run_p, state.p_min)
-    s_min = jnp.where(use_run, run_s, state.s_min)
-
-    change = check & (ps > p_min + params.out_control_level * s_min)
-    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
-
-    idx = jnp.arange(b, dtype=jnp.int32)
-    has_change = jnp.any(change)
-    cpos = jnp.argmax(change).astype(jnp.int32)  # first True (0 if none)
-    first_change = jnp.where(has_change, cpos, jnp.int32(-1))
-
+    first_change = _first_true(change)
     # Warnings at positions the reference loop never reached don't count.
-    limit = jnp.where(has_change, cpos, jnp.int32(b))
-    warning_seen = warning & (idx <= limit)
-    has_warn = jnp.any(warning_seen)
-    wpos = jnp.argmax(warning_seen).astype(jnp.int32)
-    first_warning = jnp.where(has_warn, wpos, jnp.int32(-1))
-
-    new_state = DDMState(
-        count=cnt[-1],
-        err_sum=esum[-1],
-        ps_min=ps_min[-1],
-        p_min=p_min[-1],
-        s_min=s_min[-1],
-    )
+    limit = jnp.where(first_change >= 0, first_change, jnp.int32(b))
+    first_warning = _first_true(warning, limit)
     return new_state, DDMBatchResult(first_warning, first_change)
+
+
+def ddm_window(
+    state: DDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: DDMParams = DDMParams(),
+) -> tuple[DDMState, DDMWindowResult]:
+    """Speculative multi-batch update: W consecutive microbatches in one shot.
+
+    Semantically identical to applying :func:`ddm_batch` to each of the W
+    batches in order **with no reset in between** — the detector state flows
+    across batch boundaries exactly as the engine carries it
+    (``DDM_Process.py:202``). The caller speculates that no change occurs in
+    the window; per-batch results for batches *after* the first changed batch
+    are garbage (the engine would have reset + retrained there) and must be
+    discarded and recomputed by the caller (see ``engine.window``).
+
+    Args:
+      state: carried :class:`DDMState`.
+      errs: ``[W, B]`` f32 error indicators, batch-major.
+      valid: ``[W, B]`` bool mask.
+      params: detector thresholds.
+
+    Returns:
+      ``(state_after_full_window, DDMWindowResult)`` with ``[W]`` leaves.
+    """
+    w, b = errs.shape
+    end_state, warning, change = _prefix_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    change = change.reshape(w, b)
+    warning = warning.reshape(w, b)
+
+    first_change = _first_true(change)  # [W]
+    limit = jnp.where(first_change >= 0, first_change, jnp.int32(b))
+    first_warning = _first_true(warning, limit)
+    return end_state, DDMWindowResult(first_warning, first_change)

@@ -33,7 +33,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import DDMParams
-from ..engine.loop import Batches, FlagRows, make_partition_runner
+from ..engine.loop import (
+    Batches,
+    FlagRows,
+    IndexedBatches,
+    make_partition_runner,
+)
 from ..models.base import Model
 
 PARTITION_AXIS = "partitions"
@@ -65,22 +70,48 @@ def make_mesh_runner(
     *,
     shuffle: bool = True,
     retrain_error_threshold: float | None = None,
+    window: int = 1,
+    indexed: bool = False,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
     ``batches`` leaves carry a leading partition axis ``[P, ...]`` sharded
     over the mesh; ``keys`` is ``[P]`` of PRNG keys. With ``mesh=None`` the
     same program runs single-device (one chip still vmaps over partitions).
-    """
-    run_one = make_partition_runner(
-        model,
-        ddm_params,
-        shuffle=shuffle,
-        retrain_error_threshold=retrain_error_threshold,
-    )
-    vmapped = jax.vmap(run_one)
 
-    def run(batches: Batches, keys: jax.Array) -> MeshRunResult:
+    ``window > 1`` selects the speculative window engine (``engine.window``)
+    — same flags, ~10× fewer sequential steps; ``window = 1`` is the
+    batch-per-step sequential scan. ``indexed=True`` builds the runner for
+    :class:`IndexedBatches` (compressed stream: row table replicated across
+    the mesh, index planes sharded; requires ``window > 1``).
+    """
+    if indexed and window <= 1:
+        raise ValueError("indexed batches require the window engine (window > 1)")
+    if window > 1:
+        from ..engine.window import make_window_runner
+
+        run_one = make_window_runner(
+            model,
+            ddm_params,
+            window=window,
+            shuffle=shuffle,
+            retrain_error_threshold=retrain_error_threshold,
+        )
+    else:
+        run_one = make_partition_runner(
+            model,
+            ddm_params,
+            shuffle=shuffle,
+            retrain_error_threshold=retrain_error_threshold,
+        )
+    if indexed:
+        # Row table replicated (None axes), index planes partition-major.
+        batch_axes = IndexedBatches(None, None, 0, 0, 0)
+    else:
+        batch_axes = Batches(0, 0, 0, 0)
+    vmapped = jax.vmap(run_one, in_axes=(batch_axes, 0))
+
+    def run(batches, keys: jax.Array) -> MeshRunResult:
         flags = vmapped(batches, keys)
         changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
         # Cross-partition reduction: lowers to an ICI all-reduce when the
@@ -92,19 +123,40 @@ def make_mesh_runner(
         return jax.jit(run)
 
     data_sharding = NamedSharding(mesh, P(PARTITION_AXIS))
+    replicated = NamedSharding(mesh, P())
+    if indexed:
+        in_batches = IndexedBatches(
+            replicated, replicated, data_sharding, data_sharding, data_sharding
+        )
+    else:
+        in_batches = Batches(*(data_sharding,) * 4)
     out_sharding = MeshRunResult(
         flags=FlagRows(*(data_sharding,) * len(FlagRows._fields)),
-        drift_vote=NamedSharding(mesh, P()),  # replicated after the all-reduce
+        drift_vote=replicated,  # replicated after the all-reduce
     )
-    return jax.jit(run, in_shardings=(
-        Batches(*(data_sharding,) * 4),
-        data_sharding,
-    ), out_shardings=out_sharding)
+    return jax.jit(
+        run, in_shardings=(in_batches, data_sharding), out_shardings=out_sharding
+    )
 
 
-def shard_batches(batches: Batches, keys: jax.Array, mesh: Mesh | None):
-    """Host→device placement of the striped stream (the ``:222`` upload)."""
+def shard_batches(batches, keys: jax.Array, mesh: Mesh | None):
+    """Host→device placement of the striped stream (the ``:222`` upload).
+
+    :class:`Batches` planes are partition-sharded; an :class:`IndexedBatches`
+    row table is replicated to every device (it is tiny — the whole point of
+    the compressed form) while its index planes are partition-sharded.
+    """
     if mesh is None:
         return jax.device_put(batches), jax.device_put(keys)
     sh = NamedSharding(mesh, P(PARTITION_AXIS))
+    if isinstance(batches, IndexedBatches):
+        rep = NamedSharding(mesh, P())
+        placed = IndexedBatches(
+            base_X=jax.device_put(batches.base_X, rep),
+            base_y=jax.device_put(batches.base_y, rep),
+            idx=jax.device_put(batches.idx, sh),
+            rows=jax.device_put(batches.rows, sh),
+            valid=jax.device_put(batches.valid, sh),
+        )
+        return placed, jax.device_put(keys, sh)
     return jax.device_put(batches, sh), jax.device_put(keys, sh)

@@ -43,7 +43,10 @@ bool parse_line(const char* p, const char* end, float* out, int64_t cols) {
   int64_t c = 0;
   while (c < cols) {
     while (p < end && (*p == ' ' || *p == '\t')) ++p;
-    if (p < end && *p == '+') ++p;  // from_chars rejects leading '+'
+    if (p < end && *p == '+') {  // from_chars rejects leading '+'
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) return false;  // "+-3.5"
+    }
     double v = 0.0;
     auto [next, ec] = std::from_chars(p, end, v);
     if (ec != std::errc() || next == p) return false;

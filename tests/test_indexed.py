@@ -1,0 +1,103 @@
+"""Compressed (indexed) stream path: bit-exact parity with materialized.
+
+The indexed representation (``engine.loop.IndexedBatches``) is a transport
+optimization — row table + index planes instead of the duplicated stream —
+and must change nothing observable: striping, shuffling, flags, metrics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_drift_detection_tpu import DDMParams, RunConfig, replace, run
+from distributed_drift_detection_tpu.engine import Batches, IndexedBatches
+from distributed_drift_detection_tpu.engine.window import make_window_runner
+from distributed_drift_detection_tpu.io import (
+    materialize_batches,
+    stripe_partitions,
+    stripe_partitions_indexed,
+    synthesize_stream,
+)
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+OUTDOOR = "/root/reference/outdoorStream.csv"
+
+
+def small_stream(mult=4, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(120, 5)).astype(np.float32)
+    y = rng.integers(0, 4, 120).astype(np.int64)
+    return synthesize_stream(X, y, mult_data=mult, seed=seed)
+
+
+def test_synthesize_keeps_compressed_form():
+    s = small_stream(mult=4)
+    assert s.src is not None and s.base_X is not None
+    np.testing.assert_array_equal(s.X, s.base_X[s.src])
+    np.testing.assert_array_equal(s.y, s.base_y[s.src])
+    # every table row appears exactly `mult` times
+    np.testing.assert_array_equal(np.bincount(s.src), np.full(120, 4))
+
+
+def test_subsampled_stream_has_no_compressed_form():
+    s = small_stream(mult=0.5)
+    assert s.src is None
+
+
+@pytest.mark.parametrize("shuffle_seed", [None, 7])
+def test_indexed_striping_materializes_identically(shuffle_seed):
+    s = small_stream(mult=6)
+    p, b = 4, 11  # 720 rows / 4 → 180 → ragged 11-row grid (pad slots)
+    dense = stripe_partitions(s, p, b, shuffle_seed=shuffle_seed)
+    compressed = stripe_partitions_indexed(s, p, b, shuffle_seed=shuffle_seed)
+    assert compressed.idx.dtype == np.int16  # 120-row table fits
+    mat = materialize_batches(compressed)
+    for a, c in zip(dense, mat):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_window_runner_indexed_equals_dense(shuffle):
+    """Same key, same window: the engine must not observe the representation."""
+    s = small_stream(mult=7, seed=11)  # 840 rows / 2 → 420 → ragged at b=25
+    p, b = 2, 25
+    seed = None if shuffle else 5
+    dense = stripe_partitions(s, p, b, shuffle_seed=seed)
+    comp = stripe_partitions_indexed(s, p, b, shuffle_seed=seed)
+    spec = ModelSpec(s.num_features, s.num_classes)
+    model = build_model("centroid", spec)
+    keys = jax.random.split(jax.random.key(0), p)
+
+    run_d = make_window_runner(model, DDMParams(), window=5, shuffle=shuffle)
+    run_i = make_window_runner(model, DDMParams(), window=5, shuffle=shuffle)
+    fd = jax.jit(jax.vmap(run_d))(dense, keys)
+    fi = jax.jit(jax.vmap(run_i, in_axes=(IndexedBatches(None, None, 0, 0, 0), 0)))(
+        comp, keys
+    )
+    for a, c in zip(fd, fi):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_api_run_uses_indexed_path_and_matches_dense():
+    """End-to-end: api.run on a duplicated outdoorStream must produce the
+    same flags/metrics whether the compressed path is taken (window>1) or
+    the dense sequential path (window=1)."""
+    base = RunConfig(
+        dataset=OUTDOOR,
+        mult_data=8,
+        partitions=4,
+        per_batch=100,
+        model="centroid",
+        results_csv="",
+    )
+    fast = run(replace(base, window=8))
+    slow = run(replace(base, window=1))
+    np.testing.assert_array_equal(
+        np.asarray(fast.flags.change_global), np.asarray(slow.flags.change_global)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.flags.warning_global), np.asarray(slow.flags.warning_global)
+    )
+    assert fast.metrics.num_detections == slow.metrics.num_detections > 0
+    np.testing.assert_array_equal(fast.metrics.delays, slow.metrics.delays)

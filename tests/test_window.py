@@ -1,0 +1,197 @@
+"""Speculative window engine: exact parity with the sequential engine.
+
+The window engine (``engine.window``) must commit *bit-identical* flags to
+the batch-per-step scan (``engine.loop``) for deterministic-fit models with
+host-side shuffling — speculation is an execution strategy, not a semantics
+change. These tests drive both engines over planted-drift streams (including
+partial and fully-empty tail batches) and diff every flag row.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu import DDMParams
+from distributed_drift_detection_tpu.engine import Batches, make_partition_runner
+from distributed_drift_detection_tpu.engine.window import make_window_runner
+from distributed_drift_detection_tpu.models import (
+    ModelSpec,
+    build_model,
+    make_majority,
+)
+from distributed_drift_detection_tpu.ops import ddm_batch, ddm_init
+from distributed_drift_detection_tpu.ops.ddm import ddm_window
+
+from test_engine import planted_classification_stream, to_batches
+
+REF = DDMParams()
+
+
+# ---------------------------------------------------------------------------
+# ops.ddm_window vs chained ops.ddm_batch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ddm_window_matches_chained_ddm_batch(seed):
+    """With no reset in the window, ddm_window == ddm_batch applied W times
+    with the state threaded through — per-batch flags for every batch up to
+    (and including) the first changed one, and end state when none change."""
+    rng = np.random.default_rng(seed)
+    w_, b_ = 6, 25
+    errs = (rng.random((w_, b_)) < 0.15).astype(np.float32)
+    valid = rng.random((w_, b_)) < 0.95
+    state0 = ddm_init()
+
+    end, res = jax.jit(ddm_window)(state0, jnp.asarray(errs), jnp.asarray(valid), REF)
+
+    st = state0
+    first_changed = w_
+    for k in range(w_):
+        st, rb = ddm_batch(st, jnp.asarray(errs[k]), jnp.asarray(valid[k]), REF)
+        if k <= first_changed:
+            assert int(res.first_change[k]) == int(rb.first_change), k
+            assert int(res.first_warning[k]) == int(rb.first_warning), k
+        if first_changed == w_ and int(rb.first_change) >= 0:
+            first_changed = k
+    if first_changed == w_:  # no change anywhere → end states identical
+        for a, b in zip(end, st):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine.window vs engine.loop — exact flag parity
+# ---------------------------------------------------------------------------
+
+
+def _flags_to_array(flags):
+    return np.stack([np.asarray(leaf) for leaf in flags], axis=0)
+
+
+@pytest.mark.parametrize("window", [1, 3, 16, 64])
+@pytest.mark.parametrize("model_name", ["majority", "centroid", "linear"])
+def test_window_runner_matches_sequential(window, model_name):
+    """Deterministic-fit models, shuffle=False: every flag row identical for
+    any window width (including W=1 and W > drift spacing)."""
+    rng = np.random.default_rng(window * 31 + len(model_name))
+    X, y = planted_classification_stream(
+        rng, concepts=7, rows_per_concept=230, label_flip=0
+    )
+    per_batch = 50  # 230·7/50 → partial tail batch
+    spec = ModelSpec(X.shape[1], int(y.max()) + 1)
+    model = build_model(model_name, spec)
+    batches = to_batches(X, y, per_batch)
+    key = jax.random.key(9)
+
+    seq = jax.jit(make_partition_runner(model, REF, shuffle=False))(batches, key)
+    win = jax.jit(
+        make_window_runner(model, REF, window=window, shuffle=False)
+    )(batches, key)
+    np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
+
+
+def test_window_runner_with_noise_and_forced_retrain():
+    """Noisy labels + retrain_error_threshold: rotates from both DDM changes
+    and forced retrains still commit identically."""
+    rng = np.random.default_rng(123)
+    X, y = planted_classification_stream(
+        rng, concepts=5, rows_per_concept=300, label_flip=0.05
+    )
+    spec = ModelSpec(X.shape[1], int(y.max()) + 1)
+    model = make_majority(spec)
+    batches = to_batches(X, y, 60)
+    key = jax.random.key(4)
+    kw = dict(shuffle=False, retrain_error_threshold=0.3)
+
+    seq = jax.jit(make_partition_runner(model, REF, **kw))(batches, key)
+    win = jax.jit(make_window_runner(model, REF, window=8, **kw))(batches, key)
+    np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
+
+
+def test_window_runner_empty_tail_batches():
+    """A stream shorter than the batch grid (fully-empty trailing batches)
+    must not fire, rotate, or corrupt carried state."""
+    rng = np.random.default_rng(5)
+    X, y = planted_classification_stream(rng, concepts=3, rows_per_concept=90)
+    per_batch = 40
+    b = to_batches(X, y, per_batch)
+    # Extend with 3 fully-empty batches.
+    pad = Batches(
+        X=jnp.zeros((3, per_batch, X.shape[1]), jnp.float32),
+        y=jnp.zeros((3, per_batch), jnp.int32),
+        rows=jnp.full((3, per_batch), -1, jnp.int32),
+        valid=jnp.zeros((3, per_batch), bool),
+    )
+    batches = jax.tree.map(lambda a, p: jnp.concatenate([a, p]), b, pad)
+    spec = ModelSpec(X.shape[1], 3)
+    model = make_majority(spec)
+    key = jax.random.key(0)
+
+    seq = jax.jit(make_partition_runner(model, REF, shuffle=False))(batches, key)
+    win = jax.jit(make_window_runner(model, REF, window=4, shuffle=False))(
+        batches, key
+    )
+    np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
+    assert np.all(np.asarray(win.change_global[-3:]) == -1)
+
+
+def test_window_runner_vmap_lanes_are_independent():
+    """Under vmap, partitions with different drift positions (hence different
+    window-loop trip counts) each match their own solo run exactly."""
+    rng = np.random.default_rng(11)
+    p, per_batch = 4, 30
+    spec = ModelSpec(8, 5)
+    model = make_majority(spec)
+    runner = make_window_runner(model, REF, window=8, shuffle=False)
+    keys = jax.random.split(jax.random.key(2), p)
+
+    raw = []
+    for i in range(p):
+        # Varying concept lengths → different change positions per lane.
+        X, y = planted_classification_stream(
+            rng, concepts=3 + i % 2, rows_per_concept=120 + 30 * i
+        )
+        raw.append(to_batches(X, y, per_batch))
+    nb_target = max(bt.y.shape[0] for bt in raw)
+
+    batch_list, solo = [], []
+    for i, bt in enumerate(raw):
+        pad_n = nb_target - bt.y.shape[0]
+        padb = Batches(
+            X=jnp.zeros((pad_n, per_batch, 8), jnp.float32),
+            y=jnp.zeros((pad_n, per_batch), jnp.int32),
+            rows=jnp.full((pad_n, per_batch), -1, jnp.int32),
+            valid=jnp.zeros((pad_n, per_batch), bool),
+        )
+        bt = jax.tree.map(lambda a, q: jnp.concatenate([a, q]), bt, padb)
+        batch_list.append(bt)
+        solo.append(jax.jit(runner)(bt, keys[i]))
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list)
+    vflags = jax.jit(jax.vmap(runner))(stacked, keys)
+    for i in range(p):
+        np.testing.assert_array_equal(
+            _flags_to_array(jax.tree.map(lambda x: x[i], vflags)),
+            _flags_to_array(solo[i]),
+        )
+
+
+def test_window_shuffle_mode_detects_boundaries():
+    """In-jit shuffle mode (no host pre-shuffle): statistical behaviour —
+    every planted boundary found, no spurious detections, delay ≤ 2 batches."""
+    rng = np.random.default_rng(42)
+    concepts, rpc, per_batch = 6, 400, 100
+    X, y = planted_classification_stream(
+        rng, concepts, rpc, noise=0.01, label_flip=0
+    )
+    spec = ModelSpec(X.shape[1], concepts)
+    runner = make_window_runner(
+        build_model("centroid", spec), REF, window=16, shuffle=True
+    )
+    flags = jax.jit(runner)(to_batches(X, y, per_batch), jax.random.key(1))
+    detected = np.asarray(flags.change_global)
+    detected = detected[detected >= 0]
+    assert set((detected // rpc).tolist()) == set(range(1, concepts))
+    assert (detected % rpc).max() <= 2 * per_batch
