@@ -16,7 +16,11 @@ MULT_DATA               ``mult_data``
 FILENAME                ``dataset``
 URL / MEMORY / CORES    ``backend`` (+ backend-specific options); the Spark
                         cluster knobs have no TPU meaning and are recorded
-                        verbatim into the results CSV for table parity.
+                        verbatim into the results CSV for table parity —
+                        except ``cores``, which additionally drives the
+                        ``model='rf'`` sklearn ``n_jobs`` (mirroring the
+                        reference's ``RandomForestClassifier(n_jobs=CORES)``,
+                        ``DDM_Process.py:102``).
 =====================  =============================================
 
 Deliberate deviations (SURVEY.md quirk register):
@@ -58,7 +62,11 @@ class RunConfig:
     # --- loop (reference C7, DDM_Process.py:162-213) ---
     per_batch: int = 100
     shuffle_batches: bool = True  # seeded analog of .sample(frac=1) at :187,190
-    model: str = "linear"  # 'majority' | 'centroid' | 'linear' | 'mlp'
+    # 'majority' | 'centroid' | 'linear' | 'mlp' | 'rf' ('rf' is the
+    # host-callback reference-parity RandomForest, models/rf.py; like 'mlp'
+    # its fit consumes a PRNG key, so rf flags are seed-equivalent but not
+    # bit-equal across different `window` values).
+    model: str = "linear"
 
     # --- detector (reference C6) ---
     ddm: DDMParams = DDMParams()
@@ -92,6 +100,9 @@ class RunConfig:
     learning_rate: float = 0.5
     mlp_hidden: tuple[int, ...] = (128, 64)
     mlp_learning_rate: float = 0.05
+    # model='rf' (host-callback parity path, models/rf.py): forest size; the
+    # reference uses sklearn's default 100 trees (DDM_Process.py:102).
+    rf_estimators: int = 100
 
     # --- execution ---
     backend: str = "jax"  # 'jax' | 'spark' (stub seam, see api.py)

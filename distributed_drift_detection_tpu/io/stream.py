@@ -167,7 +167,16 @@ def synthesize_stream(
 def load_stream(
     path: str, mult_data: float = 1.0, seed: int = 0, standardize: bool = True
 ) -> StreamData:
-    X, y = load_csv(path)
+    """Dataset → prepared stream. ``path`` is a CSV file, or a ``synth:``
+    spec (e.g. ``synth:rialto,seed=1`` — see ``io.synth.parse_synth``) for
+    the generators standing in for the reference's missing large blobs
+    (SURVEY.md C16: ``rialto.csv``)."""
+    if path.startswith("synth:"):
+        from .synth import parse_synth
+
+        X, y = parse_synth(path[len("synth:") :])
+    else:
+        X, y = load_csv(path)
     return synthesize_stream(X, y, mult_data, seed, standardize)
 
 

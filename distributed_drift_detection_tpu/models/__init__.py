@@ -6,6 +6,7 @@ from .classifiers import (
     make_majority,
     make_mlp,
 )
+from .rf import make_rf
 
 __all__ = [
     "Model",
@@ -15,4 +16,5 @@ __all__ = [
     "make_linear",
     "make_majority",
     "make_mlp",
+    "make_rf",
 ]

@@ -234,6 +234,15 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
         hidden = tuple(cfg.mlp_hidden) if cfg is not None else (128, 64)
         lr = cfg.mlp_learning_rate if cfg is not None else 0.05
         return make_mlp(spec, hidden=hidden, learning_rate=lr, **kw)
+    if name == "rf":
+        from .rf import make_rf
+
+        return make_rf(
+            spec,
+            batch_size=cfg.per_batch if cfg is not None else 100,
+            n_estimators=cfg.rf_estimators if cfg is not None else 100,
+            n_jobs=cfg.cores if cfg is not None else 0,
+        )
     raise ValueError(
-        f"unknown model {name!r}; expected majority|centroid|linear|mlp"
+        f"unknown model {name!r}; expected majority|centroid|linear|mlp|rf"
     )

@@ -1,0 +1,167 @@
+"""Host-callback RandomForest — the reference-parity model.
+
+The reference's classifier is ``sklearn.RandomForestClassifier(n_jobs=CORES)``
+fitted fresh on every retrain (``DDM_Process.py:96-105``) and used for batch
+prediction (``:108-128``). Random forests are hostile to TPUs (dynamic tree
+topology, host threads), so the framework's flagship models are the pure
+pytree classifiers in ``classifiers.py`` — but SURVEY.md §7 layer 2 keeps an
+*optional host-callback RF path for parity experiments*: runs whose detection
+behaviour should be compared against the reference's actual model family.
+
+Design (TPU-native shape, host-native compute):
+
+* ``fit`` stays pure and on device — it just snapshots the training microbatch
+  ``(X, y, w)`` plus a key-derived seed into the params pytree (static shapes,
+  scan-carry friendly). No host round-trip on the fit path.
+* ``predict`` is a :func:`jax.pure_callback` that ships ``(train snapshot,
+  query rows)`` to the host, fits-or-reuses a forest there, and returns int32
+  predictions. A content-addressed LRU cache keyed by the training snapshot
+  bytes makes the "model frozen between drifts" pattern cheap: the loop calls
+  predict once per microbatch with the *same* training batch until the next
+  drift, so the forest is actually fitted once per concept — the same
+  train-on-demand economics as the reference's ``retrain`` flag
+  (``DDM_Process.py:179,194-196``).
+* ``vmap_method='sequential'`` makes the callback correct under the engine's
+  vmap-over-partitions (each partition's forest is independent, matching one
+  sklearn model per Spark group).
+
+This path is for fidelity, not speed: every microbatch crosses the
+host↔device link. Use it at reference scale (``mult_data`` ≤ a few, CPU or
+single chip) to validate that the pytree flagships detect the same drifts.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import Model, ModelSpec
+
+
+class RFParams:
+    """Params pytree: the training-batch snapshot (see module docstring)."""
+
+    # Plain tuple-ish pytree via registration below keeps leaves static-shaped.
+
+    def __init__(self, X, y, w, seed):
+        self.X = X
+        self.y = y
+        self.w = w
+        self.seed = seed
+
+    def tree_flatten(self):
+        return (self.X, self.y, self.w, self.seed), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    RFParams, RFParams.tree_flatten, RFParams.tree_unflatten
+)
+
+
+class _ForestCache:
+    """Content-addressed LRU of fitted forests (host side)."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = capacity
+        self._store: OrderedDict[bytes, object] = OrderedDict()
+
+    def get_or_fit(self, X, y, w, seed, n_estimators, n_jobs):
+        key = (
+            X.tobytes()
+            + y.tobytes()
+            + w.tobytes()
+            + np.int64(seed).tobytes()
+            + np.int64(n_estimators).tobytes()
+        )
+        forest = self._store.get(key)
+        if forest is None:
+            from sklearn.ensemble import RandomForestClassifier
+
+            mask = w > 0
+            forest = RandomForestClassifier(
+                n_estimators=n_estimators,
+                n_jobs=n_jobs or None,
+                random_state=int(seed) & 0x7FFFFFFF,
+            )
+            if mask.any():
+                forest.fit(X[mask], y[mask])
+            else:
+                forest = None  # nothing to fit on; predict falls back to 0
+            self._store[key] = forest
+            if len(self._store) > self.capacity:
+                self._store.popitem(last=False)
+        else:
+            self._store.move_to_end(key)
+        return forest
+
+
+def make_rf(
+    spec: ModelSpec,
+    batch_size: int,
+    *,
+    n_estimators: int = 100,
+    n_jobs: int = 0,
+    cache_size: int = 64,
+) -> Model:
+    """Reference-parity RandomForest as a host-callback :class:`Model`.
+
+    ``batch_size`` fixes the training-snapshot shape (the engine's
+    ``where``-select between init and fitted params needs identical leaf
+    shapes, so the snapshot is sized to the microbatch up front).
+    ``n_estimators=100`` is sklearn's default, which the reference uses
+    (``DDM_Process.py:102`` passes only ``n_jobs=CORES``); ``n_jobs`` mirrors
+    that knob (0 → sklearn default).
+    """
+    f, b = spec.num_features, int(batch_size)
+    cache = _ForestCache(cache_size)
+
+    def init(key):
+        # All-zero-weight snapshot: the host fit skips it, predict falls back
+        # to class 0 until the first real fit lands (the engine always fits
+        # on batch 0 before the first prediction).
+        return RFParams(
+            jnp.zeros((b, f), jnp.float32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.float32),
+            jnp.int32(0),
+        )
+
+    def fit(key, X, y, w):
+        seed = jax.random.randint(key, (), 0, jnp.int32(2**31 - 1))
+        return RFParams(X, y, w, seed)
+
+    def host_predict(train_X, train_y, train_w, seed, X):
+        forest = cache.get_or_fit(
+            np.asarray(train_X),
+            np.asarray(train_y),
+            np.asarray(train_w),
+            int(seed),
+            n_estimators,
+            n_jobs,
+        )
+        if forest is None or X.shape[0] == 0:
+            return np.zeros(X.shape[0], np.int32)
+        return forest.predict(np.asarray(X)).astype(np.int32)
+
+    def predict(params, X):
+        out_shape = jax.ShapeDtypeStruct((X.shape[0],), jnp.int32)
+        return jax.pure_callback(
+            host_predict,
+            out_shape,
+            params.X,
+            params.y,
+            params.w,
+            params.seed,
+            X,
+            vmap_method="sequential",
+        )
+
+    return Model("rf", init, fit, predict)
