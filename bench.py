@@ -6,14 +6,20 @@ partitions, per_batch=100 — the configuration where the reference's Spark
 cluster peaks at ≈25.7 k rows/s cluster-wide (16 instances × 4 cores,
 2.048 M rows / 79.62 s). Timed span matches the reference's "Final Time"
 (``DDM_Process.py:224→:260``): device upload + detection loop + flag
-collection + delay metric. One untimed warm-up run amortises XLA compilation
-(the reference likewise reuses a warm cluster across its grid).
+collection + delay metric.
 
 Prints ONE JSON line:
   {"metric": "rows_per_sec_chip", "value": ..., "unit": "rows/s",
    "vs_baseline": ...}  (+ diagnostic extras)
 vs_baseline is against the 25.7 k rows/s cluster-wide best — the
 BASELINE.json north star asks for ≥20×.
+
+The first device interaction of a fresh process over the remote-TPU tunnel
+can absorb tens of seconds of one-time setup (device init, remote compile
+service) that a single warm-up does not always amortise, so the benchmark
+runs two warm-ups and reports the **mean of three timed repetitions** —
+matching the reference's trial-mean methodology (its published numbers are
+means of ≥4 trials on a warm cluster, BASELINE.md).
 """
 
 import json
@@ -48,18 +54,27 @@ def main() -> None:
     )
     stream, batches, runner, keys, mesh = prepare(cfg)
 
-    # Warm-up: compile once on the real shapes.
-    db, dk = shard_batches(batches, keys, mesh)
-    jax.block_until_ready(runner(db, dk))
+    # Warm-ups: compile once on the real shapes, then once more to flush any
+    # remaining one-time device/tunnel setup out of the timed region.
+    for _ in range(2):
+        db, dk = shard_batches(batches, keys, mesh)
+        jax.block_until_ready(runner(db, dk))
 
-    # Timed run — the reference's Final Time span.
-    start = time.perf_counter()
-    db, dk = shard_batches(batches, keys, mesh)
-    out = runner(db, dk)
-    jax.block_until_ready(out)
-    change_global = np.asarray(out.flags.change_global)
-    m = delay_metrics(change_global, stream.dist_between_changes, cfg.per_batch)
-    elapsed = time.perf_counter() - start
+    # Timed runs — each spans the reference's Final Time
+    # (upload + detect + collect + delay metric); report the mean of 3,
+    # mirroring the reference's trial-mean baseline.
+    times = []
+    for _ in range(3):
+        start = time.perf_counter()
+        db, dk = shard_batches(batches, keys, mesh)
+        out = runner(db, dk)
+        jax.block_until_ready(out)
+        change_global = np.asarray(out.flags.change_global)
+        m = delay_metrics(
+            change_global, stream.dist_between_changes, cfg.per_batch
+        )
+        times.append(time.perf_counter() - start)
+    elapsed = float(np.mean(times))
 
     rows_per_sec = stream.num_rows / elapsed
     baseline = 25_700.0  # best cluster-wide rows/s of the reference (BASELINE.md)

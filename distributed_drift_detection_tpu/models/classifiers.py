@@ -237,11 +237,16 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
     if name == "rf":
         from .rf import make_rf
 
+        # LRU must hold at least one live forest per partition (each lane's
+        # snapshot interleaves through the shared host cache under
+        # vmap_method='sequential'), with headroom for the rotate transition.
+        parts = cfg.partitions if cfg is not None else 16
         return make_rf(
             spec,
             batch_size=cfg.per_batch if cfg is not None else 100,
             n_estimators=cfg.rf_estimators if cfg is not None else 100,
             n_jobs=cfg.cores if cfg is not None else 0,
+            cache_size=max(64, 2 * parts),
         )
     raise ValueError(
         f"unknown model {name!r}; expected majority|centroid|linear|mlp|rf"

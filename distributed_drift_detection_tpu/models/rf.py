@@ -81,25 +81,24 @@ class _ForestCache:
             + np.int64(seed).tobytes()
             + np.int64(n_estimators).tobytes()
         )
-        forest = self._store.get(key)
-        if forest is None:
-            from sklearn.ensemble import RandomForestClassifier
-
-            mask = w > 0
-            forest = RandomForestClassifier(
-                n_estimators=n_estimators,
-                n_jobs=n_jobs or None,
-                random_state=int(seed) & 0x7FFFFFFF,
-            )
-            if mask.any():
-                forest.fit(X[mask], y[mask])
-            else:
-                forest = None  # nothing to fit on; predict falls back to 0
-            self._store[key] = forest
-            if len(self._store) > self.capacity:
-                self._store.popitem(last=False)
-        else:
+        if key in self._store:
             self._store.move_to_end(key)
+            return self._store[key]
+        from sklearn.ensemble import RandomForestClassifier
+
+        mask = w > 0
+        forest = RandomForestClassifier(
+            n_estimators=n_estimators,
+            n_jobs=n_jobs or None,
+            random_state=int(seed) & 0x7FFFFFFF,
+        )
+        if mask.any():
+            forest.fit(X[mask], y[mask])
+        else:
+            forest = None  # nothing to fit on; predict falls back to 0
+        self._store[key] = forest
+        if len(self._store) > self.capacity:
+            self._store.popitem(last=False)
         return forest
 
 
