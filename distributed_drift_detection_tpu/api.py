@@ -22,6 +22,7 @@ collection + delay computation — not just the kernel.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import NamedTuple
 
 import jax
@@ -37,7 +38,12 @@ from .io.stream import (
 )
 from .metrics import DelayMetrics, delay_metrics, result_row
 from .models import ModelSpec, build_model
-from .parallel.mesh import make_mesh, make_mesh_runner, shard_batches
+from .parallel.mesh import (
+    make_mesh,
+    make_mesh_runner,
+    shard_batches,
+    unpack_flags,
+)
 from .results import append_result
 from .utils.timing import PhaseTimer
 
@@ -50,6 +56,46 @@ class PreparedRun(NamedTuple):
     runner: object  # jitted (batches, keys) -> MeshRunResult
     keys: jax.Array
     mesh: object  # jax.sharding.Mesh | None
+
+
+# Compiled-runner LRU: repeated run()/prepare() calls with the same static
+# configuration (the 5-trial grid harness, C12-C14) reuse one jitted runner
+# instead of re-tracing a fresh closure per call (~1s/trial on the remote-TPU
+# path even with a warm persistent compile cache). model='rf' runners are
+# never cached — their closures pin host-side fitted-forest state.
+_RUNNER_CACHE: OrderedDict = OrderedDict()
+
+
+def _cached_runner(cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool):
+    def build():
+        model = build_model(cfg.model, spec, cfg)
+        mesh = make_mesh(n_dev) if n_dev > 1 else None
+        runner = make_mesh_runner(
+            model,
+            cfg.ddm,
+            mesh,
+            shuffle=False,  # batches are shuffled host-side at stripe time
+            retrain_error_threshold=cfg.retrain_error_threshold,
+            window=cfg.window,
+            indexed=indexed,
+        )
+        return runner, mesh
+
+    if cfg.model == "rf":
+        return build()
+    key = (
+        cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
+        cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
+        cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
+    )
+    if key in _RUNNER_CACHE:
+        _RUNNER_CACHE.move_to_end(key)
+        return _RUNNER_CACHE[key]
+    out = build()
+    _RUNNER_CACHE[key] = out
+    if len(_RUNNER_CACHE) > 8:
+        _RUNNER_CACHE.popitem(last=False)
+    return out
 
 
 def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
@@ -70,7 +116,6 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
     )
     spec = ModelSpec(stream.num_features, stream.num_classes)
-    model = build_model(cfg.model, spec, cfg)
     n_dev = cfg.mesh_devices or len(jax.devices())
     n_dev = min(n_dev, len(jax.devices()))
     # The mesh size must divide the partition count; fall back toward fewer
@@ -78,16 +123,7 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # cluster existed).
     while n_dev > 1 and cfg.partitions % n_dev:
         n_dev -= 1
-    mesh = make_mesh(n_dev) if n_dev > 1 else None
-    runner = make_mesh_runner(
-        model,
-        cfg.ddm,
-        mesh,
-        shuffle=False,  # already shuffled host-side above
-        retrain_error_threshold=cfg.retrain_error_threshold,
-        window=cfg.window,
-        indexed=indexed,
-    )
+    runner, mesh = _cached_runner(cfg, spec, n_dev, indexed)
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
     return PreparedRun(stream, batches, runner, keys, mesh)
 
@@ -125,8 +161,15 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
         out = runner(dev_batches, dev_keys)
         jax.block_until_ready(out)
     with timer.phase("collect"):
-        flags = jax.tree.map(np.asarray, out.flags)
-        vote = np.asarray(out.drift_vote)
+        # One latency-bound d2h transfer of the packed flag table; the drift
+        # vote is recomputed host-side from it in f32, matching the device
+        # reduction's dtype and arithmetic (sum of exact 0/1 indicators, one
+        # f32 divide).
+        flags = unpack_flags(np.asarray(out.packed))
+        changed = (flags.change_global >= 0).astype(np.float32)
+        vote = changed.sum(axis=0, dtype=np.float32) / np.float32(
+            changed.shape[0]
+        )
         m = delay_metrics(
             flags.change_global, stream.dist_between_changes, cfg.per_batch
         )

@@ -61,6 +61,22 @@ def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
 class MeshRunResult(NamedTuple):
     flags: FlagRows  # leaves [P, NB-1]
     drift_vote: jax.Array  # [NB-1] f32: fraction of partitions flagging change
+    # The five flag leaves stacked into one i32 [5, P, NB-1] array: the
+    # device→host link of the remote-TPU path is latency-bound (~0.1 s per
+    # transfer regardless of size), so the collect phase fetches this single
+    # array instead of five leaves. Unpack with :func:`unpack_flags`.
+    packed: jax.Array
+
+
+def unpack_flags(packed: np.ndarray) -> FlagRows:
+    """Rebuild host-side :class:`FlagRows` from ``MeshRunResult.packed``."""
+    return FlagRows(
+        warning_local=packed[0],
+        warning_global=packed[1],
+        change_local=packed[2],
+        change_global=packed[3],
+        forced_retrain=packed[4].astype(bool),
+    )
 
 
 def make_mesh_runner(
@@ -117,7 +133,14 @@ def make_mesh_runner(
         # Cross-partition reduction: lowers to an ICI all-reduce when the
         # partition axis is device-sharded (the psum drift vote of SURVEY §2).
         vote = jnp.sum(changed, axis=0) / changed.shape[0]
-        return MeshRunResult(flags=flags, drift_vote=vote)
+        packed = jnp.stack([
+            flags.warning_local,
+            flags.warning_global,
+            flags.change_local,
+            flags.change_global,
+            flags.forced_retrain.astype(jnp.int32),
+        ])
+        return MeshRunResult(flags=flags, drift_vote=vote, packed=packed)
 
     if mesh is None:
         return jax.jit(run)
@@ -133,6 +156,7 @@ def make_mesh_runner(
     out_sharding = MeshRunResult(
         flags=FlagRows(*(data_sharding,) * len(FlagRows._fields)),
         drift_vote=replicated,  # replicated after the all-reduce
+        packed=NamedSharding(mesh, P(None, PARTITION_AXIS)),
     )
     return jax.jit(
         run, in_shardings=(in_batches, data_sharding), out_shardings=out_sharding
