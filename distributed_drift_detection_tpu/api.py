@@ -78,6 +78,7 @@ def _cached_runner(cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool):
             retrain_error_threshold=cfg.retrain_error_threshold,
             window=cfg.window,
             indexed=indexed,
+            ddm_impl=cfg.ddm_kernel,
         )
         return runner, mesh
 
@@ -87,6 +88,7 @@ def _cached_runner(cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool):
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
+        cfg.ddm_kernel,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

@@ -94,6 +94,11 @@ class RunConfig:
     # seed-equivalent but not bit-equal across different window values — pin
     # window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
     window: int = 16
+    # DDM window-statistic implementation: 'xla' (cumsum + associative_scan)
+    # or 'pallas' (ops/ddm_pallas.py — the whole statistic fused into one
+    # VMEM-resident TPU kernel, partitions on the sublane axis; bit-identical
+    # flags, interpreter fallback on CPU). Requires window > 1.
+    ddm_kernel: str = "xla"
 
     # --- model hyper-parameters (TPU-native replacements for RandomForest) ---
     fit_steps: int = 32

@@ -62,6 +62,7 @@ def make_window_runner(
     window: int = 16,
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
+    ddm_impl: str = "xla",
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
 
@@ -73,6 +74,12 @@ def make_window_runner(
     """
     w = int(window)
     assert w >= 1
+    if ddm_impl == "pallas":
+        from ..ops.ddm_pallas import ddm_window_pallas as _ddm_window
+    elif ddm_impl == "xla":
+        _ddm_window = ddm_window
+    else:
+        raise ValueError(f"unknown ddm_impl {ddm_impl!r}; expected 'xla' or 'pallas'")
 
     def run(batches: Batches | IndexedBatches, key: jax.Array) -> FlagRows:
         indexed = isinstance(batches, IndexedBatches)
@@ -172,7 +179,7 @@ def make_window_runner(
 
             # Speculative DDM over the flattened window (state flows across
             # batch boundaries — ``DDM_Process.py:202``).
-            new_ddm, res = ddm_window(st.ddm, errs, sl_valid, ddm_params)
+            new_ddm, res = _ddm_window(st.ddm, errs, sl_valid, ddm_params)
             change = (res.first_change >= 0) & ne  # [W]
 
             if retrain_error_threshold is not None:

@@ -68,15 +68,15 @@ class MeshRunResult(NamedTuple):
     packed: jax.Array
 
 
+_BOOL_FLAGS = frozenset({"forced_retrain"})
+
+
 def unpack_flags(packed: np.ndarray) -> FlagRows:
     """Rebuild host-side :class:`FlagRows` from ``MeshRunResult.packed``."""
-    return FlagRows(
-        warning_local=packed[0],
-        warning_global=packed[1],
-        change_local=packed[2],
-        change_global=packed[3],
-        forced_retrain=packed[4].astype(bool),
-    )
+    return FlagRows(**{
+        name: packed[i].astype(bool) if name in _BOOL_FLAGS else packed[i]
+        for i, name in enumerate(FlagRows._fields)
+    })
 
 
 def make_mesh_runner(
@@ -88,6 +88,7 @@ def make_mesh_runner(
     retrain_error_threshold: float | None = None,
     window: int = 1,
     indexed: bool = False,
+    ddm_impl: str = "xla",
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
@@ -103,6 +104,11 @@ def make_mesh_runner(
     """
     if indexed and window <= 1:
         raise ValueError("indexed batches require the window engine (window > 1)")
+    if ddm_impl != "xla" and window <= 1:
+        raise ValueError(
+            f"ddm_impl={ddm_impl!r} requires the window engine (window > 1); "
+            "the sequential batch-per-step scan only has the XLA detector"
+        )
     if window > 1:
         from ..engine.window import make_window_runner
 
@@ -112,6 +118,7 @@ def make_mesh_runner(
             window=window,
             shuffle=shuffle,
             retrain_error_threshold=retrain_error_threshold,
+            ddm_impl=ddm_impl,
         )
     else:
         run_one = make_partition_runner(
@@ -133,13 +140,9 @@ def make_mesh_runner(
         # Cross-partition reduction: lowers to an ICI all-reduce when the
         # partition axis is device-sharded (the psum drift vote of SURVEY §2).
         vote = jnp.sum(changed, axis=0) / changed.shape[0]
-        packed = jnp.stack([
-            flags.warning_local,
-            flags.warning_global,
-            flags.change_local,
-            flags.change_global,
-            flags.forced_retrain.astype(jnp.int32),
-        ])
+        packed = jnp.stack(
+            [getattr(flags, f).astype(jnp.int32) for f in FlagRows._fields]
+        )
         return MeshRunResult(flags=flags, drift_vote=vote, packed=packed)
 
     if mesh is None:
