@@ -178,6 +178,12 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     total_time = time.perf_counter() - start
     # --- span ends (:260) ---
 
+    if cfg.validate:
+        from .utils.validate import validate_flag_rows
+
+        nb = (batches.idx if hasattr(batches, "idx") else batches.y).shape[1]
+        validate_flag_rows(flags, nb, cfg.per_batch, stream.num_rows)
+
     if cfg.results_csv:
         append_result(cfg.results_csv, result_row(cfg, total_time, m, stream.num_rows))
 

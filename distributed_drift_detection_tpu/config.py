@@ -112,6 +112,11 @@ class RunConfig:
     # --- execution ---
     backend: str = "jax"  # 'jax' | 'spark' (stub seam, see api.py)
     seed: int = 0
+    # Host-side structural audit of the collected flag table after every run
+    # (utils.validate.validate_flag_rows); raises on corruption. Cheap (runs
+    # on the tiny flag table), off by default for exact reference parity of
+    # the timed span.
+    validate: bool = False
 
     # --- bookkeeping (recorded verbatim into the results CSV, C11 parity) ---
     app_name: str = ""

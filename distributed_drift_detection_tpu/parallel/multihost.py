@@ -1,0 +1,201 @@
+"""Multi-host (DCN) scaling of the partition mesh.
+
+The reference scales out by pointing more Spark executors at a standalone
+master over the network (``DDM_Process.py:6,61-72``; SURVEY.md §2 "Distributed
+communication backend"). The TPU-native equivalent spans *hosts*: each host
+owns a TPU slice-piece, JAX's runtime carries collectives over ICI within a
+slice and DCN across slices, and the control plane is
+``jax.distributed.initialize`` instead of a Spark master URL.
+
+The stream workload makes this easy: partitions never communicate during the
+loop (embarrassingly parallel, matching the reference's zero worker↔worker
+traffic), so the only cross-host traffic is the end-of-run drift-vote
+all-reduce and flag gather — a few KB over DCN.
+
+Usage on an N-host pod (same program on every host, e.g. via the TPU VM
+launcher)::
+
+    from distributed_drift_detection_tpu.parallel import multihost
+
+    multihost.initialize()              # DCN control plane (env-signalled)
+    mesh = multihost.global_mesh()      # 1-D mesh over ALL hosts' devices
+    batches = stripe_partitions(stream, partitions, per_batch)
+    sl = multihost.host_partition_slice(partitions, mesh)
+    local, lkeys = multihost.local_stripe(batches, keys, sl)
+    db, dk = multihost.shard_batches_global(local, lkeys, mesh, partitions)
+    runner = make_mesh_runner(model, ddm, mesh, ...)
+    out = runner(db, dk)                # flags gathered across hosts
+
+Each host feeds only its own partitions (``host_partition_slice``), so the
+host→device upload scales with 1/num_hosts — the analog of the reference
+having each executor read its own stripe rather than the driver shipping the
+whole dataframe (its 512 MB RPC ceiling, ``DDM_Process.py:70``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import PARTITION_AXIS, Mesh
+
+
+# Environment variables whose presence signals a multi-process launch (JAX's
+# own coordinator override, or a cluster manager that sets the coordinator
+# explicitly).
+_DCN_ENV_SIGNALS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def _multiprocess_signalled() -> bool:
+    import os
+
+    if any(os.environ.get(v) for v in _DCN_ENV_SIGNALS):
+        return True
+    # TPU pod metadata: set on every TPU VM; signals a *pod* only when it
+    # lists more than one worker hostname.
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def initialize(**kwargs) -> None:
+    """Start the DCN control plane (single-process safe).
+
+    Thin wrapper over :func:`jax.distributed.initialize` with one rule: the
+    decision to go distributed is made **before touching any JAX API**
+    (querying the backend would initialise it locally and make a later
+    distributed init impossible). With explicit kwargs
+    (``coordinator_address``/``num_processes``/``process_id``) or any of the
+    coordinator environment signals present, initialization runs and errors
+    **propagate** — a misconfigured pod must fail loudly, not degrade into N
+    silent single-host runs. With neither, this is a no-op: a single-process
+    run (CPU tests, one chip) whose local backend is the whole "cluster",
+    the analog of the reference's local Spark mode.
+
+    On managed pods whose launcher relies on JAX's cluster autodetection
+    without setting any of the signal variables, call
+    ``initialize(coordinator_address=...)`` explicitly (or export
+    ``JAX_COORDINATOR_ADDRESS``).
+    """
+    if not kwargs and not _multiprocess_signalled():
+        return
+    jax.distributed.initialize(**kwargs)
+
+
+def global_mesh() -> Mesh:
+    """1-D partition mesh over every device of every host."""
+    from .mesh import make_mesh
+
+    return make_mesh()
+
+
+def host_partition_slice(partitions: int, mesh: Mesh) -> slice:
+    """The contiguous range of partition indices this host must feed.
+
+    Partitions are laid out contiguously over the mesh's device order, so a
+    host's share is ``partitions * (local devices / global devices)``
+    starting at its first addressable device's position.
+    """
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if partitions % n:
+        raise ValueError(f"{partitions} partitions not divisible by {n} devices")
+    per_dev = partitions // n
+    local = [i for i, d in enumerate(devices) if d.process_index == jax.process_index()]
+    if not local:
+        return slice(0, 0)
+    if local != list(range(local[0], local[0] + len(local))):
+        raise ValueError("host's devices are not contiguous in the mesh")
+    return slice(local[0] * per_dev, (local[-1] + 1) * per_dev)
+
+
+def local_stripe(batches, keys: jax.Array, sl: slice):
+    """Slice the host's own partitions out of host-striped arrays.
+
+    Sharded planes are cut to ``sl``; an :class:`IndexedBatches` row table is
+    replicated, so it passes through whole.
+    """
+    from ..engine.loop import IndexedBatches
+
+    if isinstance(batches, IndexedBatches):
+        return (
+            IndexedBatches(
+                base_X=batches.base_X,
+                base_y=batches.base_y,
+                idx=batches.idx[sl],
+                rows=batches.rows[sl],
+                valid=batches.valid[sl],
+            ),
+            keys[sl],
+        )
+    return jax.tree.map(lambda x: x[sl], batches), keys[sl]
+
+
+def shard_batches_global(
+    batches, keys: jax.Array, mesh: Mesh, partitions: int | None = None
+):
+    """Multi-host upload: each host contributes its own partition stripe.
+
+    Builds globally-sharded arrays from *process-local* data via
+    :func:`jax.make_array_from_process_local_data` — the DCN-era replacement
+    for the reference's whole-dataframe RPC upload. In multi-host runs the
+    sharded planes (``batches`` grids, ``keys``) must be **this host's
+    stripe only** (cut with :func:`host_partition_slice` +
+    :func:`local_stripe`); replicated planes (the compressed-stream row
+    table) are the full arrays on every host. Pass the global ``partitions``
+    count explicitly so hosts that contribute zero partitions still agree on
+    the global shape.
+
+    On a single process this degenerates to ``parallel.shard_batches``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if jax.process_count() == 1:
+        from .mesh import shard_batches
+
+        return shard_batches(batches, keys, mesh)
+
+    sharded = NamedSharding(mesh, P(PARTITION_AXIS))
+    replicated = NamedSharding(mesh, P())
+    if partitions is None:
+        n_local = sum(
+            1 for d in mesh.devices.flat
+            if d.process_index == jax.process_index()
+        )
+        if n_local == 0:
+            raise ValueError(
+                "this process addresses no devices in the mesh; pass the "
+                "global `partitions` count explicitly"
+            )
+        ratio = mesh.devices.size // n_local
+
+    def put(x, sharding):
+        # Typed PRNG keys travel as their uint32 key data.
+        is_key = jnp.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key)
+        impl = jax.random.key_impl(x) if is_key else None
+        x = np.asarray(jax.random.key_data(x) if is_key else x)
+        global_shape = x.shape
+        if sharding is sharded:
+            parts = partitions if partitions is not None else x.shape[0] * ratio
+            global_shape = (parts, *x.shape[1:])
+        out = jax.make_array_from_process_local_data(sharding, x, global_shape)
+        return jax.random.wrap_key_data(out, impl=impl) if is_key else out
+
+    from ..engine.loop import IndexedBatches
+
+    if isinstance(batches, IndexedBatches):
+        placed = IndexedBatches(
+            base_X=put(batches.base_X, replicated),
+            base_y=put(batches.base_y, replicated),
+            idx=put(batches.idx, sharded),
+            rows=put(batches.rows, sharded),
+            valid=put(batches.valid, sharded),
+        )
+    else:
+        placed = jax.tree.map(lambda x: put(x, sharded), batches)
+    return placed, put(keys, sharded)

@@ -1,0 +1,116 @@
+"""Numeric-sanity validation — the framework's sanitizer subsystem.
+
+SURVEY.md §5 (race detection / sanitizers): the reference has no shared
+mutable state to race on, so the TPU-native equivalent is *jit purity plus
+functional checks on the detector statistics*. Two layers:
+
+* :func:`checked_ddm_window` — a ``jax.experimental.checkify`` wrapping of the
+  DDM window kernel that validates its contract **inside jit**: error inputs
+  are 0/1 indicators, the carried state is a coherent ``(count, err_sum)``
+  pair, and the post-update statistics are finite. Use it when developing new
+  feeders/models; the checks compile into the program and survive jit/vmap.
+* :func:`validate_flag_rows` — a host-side structural audit of a run's flag
+  table (sentinel domain, index ranges, warning/change exclusivity), cheap
+  enough to run on every collect. Enabled in ``api.run`` via
+  ``RunConfig(validate=True)``.
+
+The reference's only analog is eyeballing the results CSV; these checks catch
+the failure modes a TPU port actually risks — padding rows leaking into the
+statistics, f32 overflow in long windows, index-plane corruption in the
+compressed stream path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+from ..config import DDMParams
+from ..ops.ddm import DDMState, ddm_window
+
+
+def checked_ddm_window(
+    state: DDMState,
+    errs,
+    valid,
+    params: DDMParams = DDMParams(),
+):
+    """:func:`ops.ddm.ddm_window` with in-jit contract checks.
+
+    Returns ``(err, (end_state, result))`` in checkify style;
+    ``err.throw()`` raises on the first violated check.
+    """
+
+    def f(state, errs, valid):
+        checkify.check(
+            jnp.all((errs == 0.0) | (errs == 1.0)),
+            "errs must be 0/1 error indicators",
+        )
+        checkify.check(
+            state.count >= 0, "detector count must be non-negative"
+        )
+        checkify.check(
+            (state.err_sum >= -1e-3)
+            & (state.err_sum <= state.count.astype(jnp.float32) + 1e-3),
+            "err_sum must lie in [0, count]",
+        )
+        # f32 error sums are exact below 2**24 elements between resets
+        # (ops.ddm numerical note); past that the p statistic silently loses
+        # precision, so fail loudly instead.
+        checkify.check(
+            state.count.astype(jnp.float32) + errs.size < 2.0**24,
+            "detector count near f32 exactness limit (2^24); reset overdue",
+        )
+        end, res = ddm_window(state, errs, valid, params)
+        checkify.check(
+            jnp.isfinite(end.err_sum) & (end.count >= state.count),
+            "post-update state must be finite and monotone in count",
+        )
+        return end, res
+
+    return checkify.checkify(f)(state, errs, valid)
+
+
+def validate_flag_rows(
+    flags, num_batches: int, per_batch: int, num_rows: int
+) -> None:
+    """Structural audit of a run's collected flag table (host side).
+
+    ``flags`` is a host :class:`engine.loop.FlagRows` with ``[P, NB-1]``
+    leaves (``api.RunResult.flags``). Raises ``ValueError`` with the first
+    violation found.
+    """
+    wl = np.asarray(flags.warning_local)
+    wg = np.asarray(flags.warning_global)
+    cl = np.asarray(flags.change_local)
+    cg = np.asarray(flags.change_global)
+
+    def fail(msg):
+        raise ValueError(f"flag-table validation failed: {msg}")
+
+    if not (wl.shape == wg.shape == cl.shape == cg.shape):
+        fail("flag planes disagree on shape")
+    if wl.shape[1] > max(num_batches - 1, 0):
+        fail(
+            f"{wl.shape[1]} flag rows for {num_batches} batches "
+            "(expected at most num_batches - 1)"
+        )
+    for name, local in (("warning_local", wl), ("change_local", cl)):
+        bad = (local < -1) | (local >= per_batch)
+        if bad.any():
+            fail(f"{name} outside [-1, per_batch): {local[bad][:5].tolist()}")
+    for name, glob, local in (
+        ("warning_global", wg, wl),
+        ("change_global", cg, cl),
+    ):
+        if ((glob < -1) | (glob >= num_rows)).any():
+            fail(f"{name} outside [-1, num_rows)")
+        if ((glob >= 0) != (local >= 0)).any():
+            fail(f"{name} sentinel disagrees with its local column")
+    # The reference records a warning only when it precedes the change in the
+    # same batch (first-warning scan stops at the change, C6 :147-152).
+    both = (wl >= 0) & (cl >= 0)
+    if (wl[both] > cl[both]).any():
+        fail("warning recorded after the change within a batch")

@@ -1,0 +1,95 @@
+"""Multi-host helpers, exercised on the single-process 8-device CPU mesh.
+
+Real DCN spans can't run in CI (one process); these tests pin the parts that
+are host-count-independent: idempotent initialize, global mesh construction,
+partition-slice arithmetic, and the single-process degeneration of the
+global upload path (must be bit-identical to ``parallel.shard_batches``).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu.engine import Batches
+from distributed_drift_detection_tpu.parallel import multihost
+from distributed_drift_detection_tpu.parallel.mesh import (
+    PARTITION_AXIS,
+    make_mesh,
+    shard_batches,
+)
+
+
+def test_initialize_is_noop_without_coordinator_signal():
+    """No kwargs + no coordinator env vars → must not touch the backend (and
+    must not raise); single-process runs stay local."""
+    assert not multihost._multiprocess_signalled()
+    multihost.initialize()  # must not raise
+    assert jax.process_count() == 1
+
+
+def test_multiprocess_signal_detection(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert not multihost._multiprocess_signalled()  # single worker ≠ pod
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b")
+    assert multihost._multiprocess_signalled()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert multihost._multiprocess_signalled()
+
+
+def test_local_stripe_slices_partition_planes():
+    from distributed_drift_detection_tpu.engine.loop import IndexedBatches
+
+    ib = IndexedBatches(
+        base_X=jnp.zeros((7, 3)),
+        base_y=jnp.zeros(7, jnp.int32),
+        idx=jnp.zeros((8, 4, 5), jnp.int32),
+        rows=jnp.zeros((8, 4, 5), jnp.int32),
+        valid=jnp.ones((8, 4, 5), bool),
+    )
+    keys = jax.random.split(jax.random.key(0), 8)
+    local, lk = multihost.local_stripe(ib, keys, slice(2, 6))
+    assert local.idx.shape[0] == 4 and lk.shape[0] == 4
+    assert local.base_X.shape == (7, 3)  # replicated plane passes through
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == (PARTITION_AXIS,)
+
+
+def test_host_partition_slice_single_host_is_everything():
+    mesh = make_mesh(8)
+    assert multihost.host_partition_slice(16, mesh) == slice(0, 16)
+
+
+def test_host_partition_slice_rejects_indivisible():
+    mesh = make_mesh(8)
+    try:
+        multihost.host_partition_slice(12, mesh)
+    except ValueError as e:
+        assert "not divisible" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_shard_batches_global_degenerates():
+    rng = np.random.default_rng(0)
+    p, nb, b, f = 8, 3, 10, 4
+    batches = Batches(
+        X=jnp.asarray(rng.normal(size=(p, nb, b, f)).astype(np.float32)),
+        y=jnp.zeros((p, nb, b), jnp.int32),
+        rows=jnp.zeros((p, nb, b), jnp.int32),
+        valid=jnp.ones((p, nb, b), bool),
+    )
+    keys = jax.random.split(jax.random.key(0), p)
+    mesh = make_mesh(8)
+    a, ka = multihost.shard_batches_global(batches, keys, mesh)
+    bref, kb = shard_batches(batches, keys, mesh)
+    np.testing.assert_array_equal(np.asarray(a.X), np.asarray(bref.X))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(ka)), np.asarray(jax.random.key_data(kb))
+    )
+    assert a.X.sharding.spec == bref.X.sharding.spec

@@ -1,0 +1,106 @@
+"""Sanitizer subsystem: checkify'd DDM contract + host-side flag audit."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu import RunConfig, run
+from distributed_drift_detection_tpu.config import replace
+from distributed_drift_detection_tpu.engine.loop import FlagRows
+from distributed_drift_detection_tpu.ops import ddm_init
+from distributed_drift_detection_tpu.utils.validate import (
+    checked_ddm_window,
+    validate_flag_rows,
+)
+
+
+def test_checked_window_accepts_valid_input():
+    rng = np.random.default_rng(0)
+    errs = (rng.random((4, 20)) < 0.2).astype(np.float32)
+    valid = np.ones((4, 20), bool)
+    err, (end, res) = jax.jit(checked_ddm_window)(
+        ddm_init(), jnp.asarray(errs), jnp.asarray(valid)
+    )
+    err.throw()  # no violation
+    assert int(end.count) == 80
+
+
+@pytest.mark.parametrize(
+    "bad_errs",
+    [np.full((2, 10), 2.0, np.float32), np.full((2, 10), np.nan, np.float32)],
+)
+def test_checked_window_rejects_non_indicator_errs(bad_errs):
+    err, _ = jax.jit(checked_ddm_window)(
+        ddm_init(), jnp.asarray(bad_errs), jnp.ones((2, 10), bool)
+    )
+    with pytest.raises(checkify_error_type()):
+        err.throw()
+
+
+def checkify_error_type():
+    from jax.experimental import checkify
+
+    return checkify.JaxRuntimeError
+
+
+def _good_flags(p=3, nbf=8, b=10):
+    i32 = np.int32
+    return FlagRows(
+        warning_local=np.full((p, nbf), -1, i32),
+        warning_global=np.full((p, nbf), -1, i32),
+        change_local=np.full((p, nbf), -1, i32),
+        change_global=np.full((p, nbf), -1, i32),
+        forced_retrain=np.zeros((p, nbf), bool),
+    )
+
+
+def test_flag_audit_passes_clean_table():
+    f = _good_flags()
+    f.change_local[1, 3] = 4
+    f.change_global[1, 3] = 34
+    validate_flag_rows(f, num_batches=9, per_batch=10, num_rows=90)
+
+
+@pytest.mark.parametrize(
+    "corrupt,msg",
+    [
+        (lambda f: f.change_local.__setitem__((0, 0), 10), "per_batch"),
+        (lambda f: f.change_global.__setitem__((0, 0), 9000), "num_rows"),
+        (
+            lambda f: f.warning_global.__setitem__((0, 0), 5),
+            "sentinel disagrees",
+        ),
+        (
+            lambda f: (
+                f.warning_local.__setitem__((0, 0), 7),
+                f.warning_global.__setitem__((0, 0), 7),
+                f.change_local.__setitem__((0, 0), 2),
+                f.change_global.__setitem__((0, 0), 2),
+            ),
+            "warning recorded after the change",
+        ),
+    ],
+)
+def test_flag_audit_catches_corruption(corrupt, msg):
+    f = _good_flags()
+    corrupt(f)
+    with pytest.raises(ValueError, match=msg):
+        validate_flag_rows(f, num_batches=9, per_batch=10, num_rows=90)
+
+
+def test_api_run_with_validation():
+    """End-to-end: validate=True audits the real flag table silently."""
+    res = run(
+        RunConfig(
+            dataset="/root/reference/outdoorStream.csv",
+            mult_data=8,
+            partitions=4,
+            per_batch=50,
+            model="centroid",
+            results_csv="",
+            validate=True,
+        )
+    )
+    assert res.metrics.num_detections > 0
