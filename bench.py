@@ -30,14 +30,84 @@ import time
 
 import numpy as np
 
+# Best cluster-wide throughput of the reference: 2.048 M rows / 79.62 s at
+# 16 instances × 4 cores (BASELINE.md); both benchmark modes compare to it.
+BASELINE_ROWS_PER_SEC = 25_700.0
+
+
+def _enable_compile_cache(jax) -> None:
+    # The remote TPU compile service can be slow; cache executables across
+    # bench invocations (shapes are stable).
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def soak(total_rows: int) -> None:
+    """--soak mode: the BASELINE.json 1e9-row sustained-throughput config,
+    run as ONE device program (engine.soak: the synthetic stream is
+    generated in-jit, zero host feeding). Reports rows/s on the chip."""
+    import jax
+
+    _enable_compile_cache(jax)
+
+    from distributed_drift_detection_tpu.engine.soak import make_soak_runner
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    p, b, drift_every = 64, 1000, 100_000
+    nb = max(total_rows // (p * b), 2)
+    run = jax.jit(
+        make_soak_runner(
+            build_model("centroid", ModelSpec(8, 8)),
+            partitions=p,
+            per_batch=b,
+            num_batches=nb,
+            drift_every=drift_every,
+        )
+    )
+    key = jax.random.key(0)
+    np.asarray(run(key).flags.change_global)  # compile + warm
+    times, cg = [], None
+    for _ in range(3):
+        start = time.perf_counter()
+        out = run(key)
+        cg = np.asarray(out.flags.change_global)
+        times.append(time.perf_counter() - start)
+    rows = int(out.rows_processed)
+    elapsed = float(np.median(times))
+    detections = int((cg >= 0).sum())
+    # Exact interior-boundary count: partition q covers global rows
+    # [q·R, (q+1)·R); a planted boundary at m·drift_every is detectable only
+    # strictly inside that half-open range (a boundary landing exactly on a
+    # partition start begins its stream — there is no preceding concept).
+    r_pp = nb * b
+    boundaries = sum(
+        ((q + 1) * r_pp - 1) // drift_every - (q * r_pp) // drift_every
+        for q in range(p)
+    )
+    delays = cg[cg >= 0] % drift_every
+    print(
+        json.dumps(
+            {
+                "metric": "soak_rows_per_sec_chip",
+                "value": round(rows / elapsed, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows / elapsed / BASELINE_ROWS_PER_SEC, 2),
+                "soak_time_s": round(elapsed, 4),
+                "rows": rows,
+                "partitions": p,
+                "detections": detections,
+                "planted_boundaries": boundaries,
+                "median_delay_rows": float(np.median(delays)) if detections else None,
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
 
 def main() -> None:
     import jax
 
-    # Persistent compile cache: the remote TPU compile service can be slow;
-    # cache executables across bench invocations (shapes are stable).
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _enable_compile_cache(jax)
 
     from distributed_drift_detection_tpu.api import prepare
     from distributed_drift_detection_tpu.config import RunConfig
@@ -79,7 +149,6 @@ def main() -> None:
     elapsed = float(np.median(times))
 
     rows_per_sec = stream.num_rows / elapsed
-    baseline = 25_700.0  # best cluster-wide rows/s of the reference (BASELINE.md)
     delay_batches = m.mean_delay_batches
     print(
         json.dumps(
@@ -87,7 +156,7 @@ def main() -> None:
                 "metric": "rows_per_sec_chip",
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / baseline, 2),
+                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 2),
                 "final_time_s": round(elapsed, 4),
                 "rows": stream.num_rows,
                 "partitions": cfg.partitions,
@@ -102,4 +171,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--soak":
+        soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
+    else:
+        main()

@@ -1,0 +1,54 @@
+"""Device-native soak engine: drift-locking and determinism."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_drift_detection_tpu.engine.soak import make_soak_runner
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+
+def _run(generator="prototypes", spec=(8, 8), **kw):
+    cfg = dict(partitions=4, per_batch=100, num_batches=100, drift_every=1000)
+    cfg.update(kw)
+    run = make_soak_runner(
+        build_model("centroid", ModelSpec(*spec)), generator=generator, **cfg
+    )
+    return jax.jit(run)(jax.random.key(0))
+
+
+def test_prototypes_soak_locks_to_planted_boundaries():
+    out = _run()
+    cg = np.asarray(out.flags.change_global)
+    det = cg >= 0
+    # 10 concepts per partition → exactly 9 internal boundaries each.
+    np.testing.assert_array_equal(det.sum(axis=1), [9, 9, 9, 9])
+    delays = cg[det] % 1000
+    assert np.percentile(delays, 95) <= 2  # row-exact detection
+    assert out.rows_processed == 4 * 100 * 100
+
+
+def test_soak_is_deterministic():
+    a = _run()
+    b = _run()
+    for la, lb in zip(a.flags, b.flags):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.parametrize("generator,f", [("sea", 3), ("hyperplane", 10)])
+def test_other_generators_execute(generator, f):
+    """SEA/hyperplane have irreducible in-concept error, under which the
+    reference's 3/0.5/1.5 DDM settings fire on noise (documented behaviour)
+    — so only shape/executability is pinned here, not drift-locking."""
+    out = _run(generator=generator, spec=(f, 2), num_batches=20)
+    assert np.asarray(out.flags.change_global).shape == (4, 19)
+
+
+def test_unknown_generator_rejected():
+    with pytest.raises(ValueError, match="unknown generator"):
+        make_soak_runner(
+            build_model("centroid", ModelSpec(3, 2)),
+            partitions=2, per_batch=10, num_batches=5, drift_every=100,
+            generator="nope",
+        )
