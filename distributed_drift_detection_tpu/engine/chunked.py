@@ -51,23 +51,41 @@ class ChunkedDetector:
         shuffle: bool = False,
         retrain_error_threshold: float | None = None,
         seed: int = 0,
+        window: int = 1,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
         # pass ``config.host_shuffle_seed(cfg)`` as the feeder's
         # ``shuffle_seed`` and leave this False. In-jit shuffle exists for
         # feeders that cannot pre-shuffle.
+        #
+        # ``window > 1`` runs each chunk through the speculative window
+        # engine (``engine.window.make_window_span``) — the carry crosses
+        # chunk boundaries identically, windows never span a boundary, and
+        # flags are bit-identical for deterministic-fit models.
         self.model = model
         self.partitions = partitions
-        step = make_partition_step(
-            model,
-            ddm_params,
-            shuffle=shuffle,
-            retrain_error_threshold=retrain_error_threshold,
-        )
+        if window > 1:
+            from .window import make_window_span
 
-        def run_chunk(carry: LoopCarry, batches: Batches):
-            return lax.scan(step, carry, batches)
+            span = make_window_span(
+                model,
+                ddm_params,
+                window=window,
+                shuffle=shuffle,
+                retrain_error_threshold=retrain_error_threshold,
+            )
+            run_chunk = span
+        else:
+            step = make_partition_step(
+                model,
+                ddm_params,
+                shuffle=shuffle,
+                retrain_error_threshold=retrain_error_threshold,
+            )
+
+            def run_chunk(carry: LoopCarry, batches: Batches):
+                return lax.scan(step, carry, batches)
 
         self._run_chunk = jax.jit(jax.vmap(run_chunk))
         self._seed = seed

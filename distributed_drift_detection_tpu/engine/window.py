@@ -40,7 +40,14 @@ from jax import lax
 from ..config import DDMParams
 from ..models.base import Model
 from ..ops.ddm import DDMState, ddm_init, ddm_window
-from .loop import Batches, FlagRows, IndexedBatches, _gather_row, _select
+from .loop import (
+    Batches,
+    FlagRows,
+    IndexedBatches,
+    LoopCarry,
+    _gather_row,
+    _select,
+)
 
 
 class _WinState(NamedTuple):
@@ -55,7 +62,7 @@ class _WinState(NamedTuple):
     flags: FlagRows  # output buffers, leaves [NBF + W]
 
 
-def make_window_runner(
+def make_window_span(
     model: Model,
     ddm_params: DDMParams,
     *,
@@ -64,13 +71,20 @@ def make_window_runner(
     retrain_error_threshold: float | None = None,
     ddm_impl: str = "xla",
 ):
-    """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
+    """Build ``span(carry: LoopCarry, batches) -> (LoopCarry, FlagRows)``.
 
-    Output contract is identical to ``engine.loop.make_partition_runner``:
-    ``FlagRows`` leaves of shape ``[NB - 1]`` (batch 0 seeds ``batch_a``).
-    The returned function is pure and jit/vmap-compatible; under ``vmap``
-    partitions advance their own window pointers in lock-step iterations
-    (finished lanes freeze — their writes land in the pad region).
+    The carry-in/carry-out form of the speculative window engine: processes
+    **every** batch of ``batches`` (no ``batch_a`` seeding — the caller owns
+    the carry), emitting one flag row per batch. This is the building block
+    for both the one-shot runner (:func:`make_window_runner`) and chunked
+    streaming (``engine.chunked`` with ``window > 1``), where the carry flows
+    across chunk boundaries exactly as the sequential step's does. Windows
+    never span a chunk boundary; with chunk length ≫ window the lost
+    speculation is negligible.
+
+    Pure and jit/vmap-compatible; under ``vmap`` partitions advance their own
+    window pointers in lock-step iterations (finished lanes freeze — their
+    writes land in the pad region).
     """
     w = int(window)
     assert w >= 1
@@ -81,18 +95,20 @@ def make_window_runner(
     else:
         raise ValueError(f"unknown ddm_impl {ddm_impl!r}; expected 'xla' or 'pallas'")
 
-    def run(batches: Batches | IndexedBatches, key: jax.Array) -> FlagRows:
+    def span(
+        carry_in: LoopCarry, batches: Batches | IndexedBatches
+    ) -> tuple[LoopCarry, FlagRows]:
         indexed = isinstance(batches, IndexedBatches)
         grid_y = batches.idx if indexed else batches.y
-        nbf = grid_y.shape[0] - 1  # flag rows (reference GROUPED_MAP rows)
+        nbf = grid_y.shape[0]  # flag rows == batches to process
         b = grid_y.shape[1]
-        key, k_init = jax.random.split(key)
+        key = carry_in.key
 
         # Pad the scanned region to NBF + W so a window slice starting at any
         # committed ptr ∈ [0, NBF] stays in bounds; pad batches are invalid.
         def pad_tail(x, fill):
             tail = jnp.full((w, *x.shape[1:]), fill, x.dtype)
-            return jnp.concatenate([x[1:], tail], axis=0)
+            return jnp.concatenate([x, tail], axis=0)
 
         if indexed:
             # Compressed stream: slice index planes, gather X/y from the
@@ -118,12 +134,12 @@ def make_window_runner(
         )
         st0 = _WinState(
             ptr=i32(0),
-            params=model.init(k_init),
-            ddm=ddm_init(),
-            a_X=mat_X(batches.idx[0]) if indexed else batches.X[0],
-            a_y=mat_y(batches.idx[0]) if indexed else batches.y[0],
-            a_w=batches.valid[0].astype(jnp.float32),
-            retrain=jnp.bool_(True),
+            params=carry_in.params,
+            ddm=carry_in.ddm,
+            a_X=carry_in.a_X,
+            a_y=carry_in.a_y,
+            a_w=carry_in.a_w,
+            retrain=carry_in.retrain,
             key=key,
             flags=buf,
         )
@@ -242,6 +258,64 @@ def make_window_runner(
             )
 
         out = lax.while_loop(cond, body, st0)
-        return jax.tree.map(lambda x: x[:nbf], out.flags)
+        carry_out = LoopCarry(
+            params=out.params,
+            ddm=out.ddm,
+            a_X=out.a_X,
+            a_y=out.a_y,
+            a_w=out.a_w,
+            retrain=out.retrain,
+            key=out.key,
+        )
+        return carry_out, jax.tree.map(lambda x: x[:nbf], out.flags)
+
+    return span
+
+
+def make_window_runner(
+    model: Model,
+    ddm_params: DDMParams,
+    *,
+    window: int = 16,
+    shuffle: bool = False,
+    retrain_error_threshold: float | None = None,
+    ddm_impl: str = "xla",
+):
+    """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
+
+    Output contract is identical to ``engine.loop.make_partition_runner``:
+    ``FlagRows`` leaves of shape ``[NB - 1]`` (batch 0 seeds ``batch_a``).
+    """
+    span = make_window_span(
+        model,
+        ddm_params,
+        window=window,
+        shuffle=shuffle,
+        retrain_error_threshold=retrain_error_threshold,
+        ddm_impl=ddm_impl,
+    )
+
+    def run(batches: Batches | IndexedBatches, key: jax.Array) -> FlagRows:
+        indexed = isinstance(batches, IndexedBatches)
+        key, k_init = jax.random.split(key)
+        if indexed:
+            a_X = batches.base_X[batches.idx[0].astype(jnp.int32)]
+            a_y = batches.base_y[batches.idx[0].astype(jnp.int32)]
+        else:
+            a_X, a_y = batches.X[0], batches.y[0]
+        carry = LoopCarry(
+            params=model.init(k_init),
+            ddm=ddm_init(),
+            a_X=a_X,
+            a_y=a_y,
+            a_w=batches.valid[0].astype(jnp.float32),
+            retrain=jnp.bool_(True),
+            key=key,
+        )
+        rest = jax.tree.map(lambda x: x[1:], batches)
+        if indexed:  # the replicated row table must not be sliced
+            rest = rest._replace(base_X=batches.base_X, base_y=batches.base_y)
+        _, flags = span(carry, rest)
+        return flags
 
     return run

@@ -6,6 +6,11 @@ memory speed, multithreaded, file read + line-indexed exactly once). Falls
 back transparently to the NumPy path when the library is absent or the data
 is malformed (strict parser — bad fields never silently become zeros); a
 failed build is attempted at most once per process.
+
+The C++ sources live at the repo root (``native/``) and ship in sdists
+(MANIFEST.in); wheel installs have no ``native/`` directory and use the
+NumPy fallback — by design, since the deployment target (TPU hosts running
+a source checkout) always has the sources.
 """
 
 from __future__ import annotations

@@ -122,3 +122,59 @@ def test_fallback_retrain_cures_deadlock():
     forced = np.asarray(f1.forced_retrain)
     assert forced.sum() == 5  # every boundary recovered via fallback
     assert (np.asarray(f1.change_global) >= 0).sum() == 0  # not fake changes
+
+
+def test_chunked_window_matches_sequential():
+    """window>1 chunked = sequential chunked, bit-exact, for a
+    deterministic-fit model with host-side (no in-jit) shuffling — the carry
+    crosses chunk boundaries identically in both engines."""
+    stream = make_stream()
+    p, b = 4, 40
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model("centroid", spec)
+
+    def flags_with(window):
+        det = ChunkedDetector(model, REF, partitions=p, seed=0, window=window)
+        chunks = chunk_stream_arrays(
+            stream.X, stream.y, p, b, chunk_batches=6, shuffle_seed=11
+        )
+        return det.run(chunks)
+
+    seq = flags_with(1)
+    win = flags_with(5)
+    for a, c in zip(seq, win):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    assert (np.asarray(seq.change_global) >= 0).any()
+
+
+def test_chunked_window_checkpoint_resume():
+    """Windowed chunked runs checkpoint/resume identically to a straight run."""
+    import tempfile, os
+
+    stream = make_stream()
+    p, b, cb = 4, 40, 6
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model("centroid", spec)
+
+    def chunks():
+        return chunk_stream_arrays(
+            stream.X, stream.y, p, b, chunk_batches=cb, shuffle_seed=3
+        )
+
+    straight = ChunkedDetector(model, REF, partitions=p, seed=0, window=4)
+    want = straight.run(chunks())
+
+    first = ChunkedDetector(model, REF, partitions=p, seed=0, window=4)
+    it = chunks()
+    got_parts = [first.feed(next(it))]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "carry.npz")
+        first.save(path)
+        second = ChunkedDetector(model, REF, partitions=p, seed=0, window=4)
+        second.restore(path, example_chunk=next(chunks()))
+        for chunk in it:
+            got_parts.append(second.feed(chunk))
+    host = [jax.tree.map(np.asarray, f) for f in got_parts]
+    got = type(want)(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
+    for a, c in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
