@@ -62,7 +62,10 @@ class ChunkedDetector:
         # ``window > 1`` runs each chunk through the speculative window
         # engine (``engine.window.make_window_span``) — the carry crosses
         # chunk boundaries identically, windows never span a boundary, and
-        # flags are bit-identical for deterministic-fit models.
+        # flags are bit-identical for deterministic-fit models with
+        # host-side shuffling (shuffle=False here + the feeder's
+        # shuffle_seed); with the in-jit shuffle the PRNG streams differ
+        # (keys split per window vs per batch).
         self.model = model
         self.partitions = partitions
         if window > 1:

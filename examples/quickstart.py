@@ -1,0 +1,43 @@
+"""Minimal end-to-end run: load a drift benchmark CSV, detect, report.
+
+Equivalent of executing the reference's ``DDM_Process.py`` once
+(SURVEY.md §3.1), on whatever accelerator JAX finds (TPU, or CPU with
+``JAX_PLATFORMS=cpu``).
+
+    python examples/quickstart.py [dataset.csv] [mult] [partitions]
+"""
+
+import sys
+
+from distributed_drift_detection_tpu import RunConfig, run
+
+
+def main():
+    # Geometry note: per_batch must stay below the per-partition concept
+    # length (mult·100/partitions for outdoorStream) or DDM hits its
+    # structural blindspot (SURVEY §7) — the defaults here keep 2 batches
+    # per concept per partition.
+    cfg = RunConfig(
+        # Default: self-contained synthetic stand-in for the paper's rialto
+        # benchmark (no CSV needed); pass a CSV path to use real data.
+        dataset=sys.argv[1] if len(sys.argv) > 1 else "synth:rialto,seed=0",
+        mult_data=float(sys.argv[2]) if len(sys.argv) > 2 else 2,
+        partitions=int(sys.argv[3]) if len(sys.argv) > 3 else 8,
+        per_batch=50,
+        model="centroid",
+        results_csv="ddm_cluster_runs.csv",  # C11 schema, appended per run
+        validate=True,  # host-side flag-table audit after the run
+    )
+    res = run(cfg)
+    m = res.metrics
+    print(f"rows            {res.stream.num_rows:,}")
+    print(f"detections      {m.num_detections}")
+    print(f"mean delay      {m.mean_delay_rows:.1f} rows "
+          f"({m.mean_delay_batches:.2f} batches)")
+    print(f"Final Time      {res.total_time:.3f} s  "
+          f"({res.stream.num_rows / res.total_time:,.0f} rows/s)")
+    print(f"phase breakdown {res.timings}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""The full experiment methodology: grid sweep → aggregate → paper figures.
+
+Reference C12–C15 (``run_experiments.sh`` + ``Plot Results.ipynb``) as one
+script. Idempotent: re-running resumes any missing trials (the built-in
+crash-recovery of ``harness.grid``).
+
+    python examples/sweep_and_plots.py [dataset.csv]
+"""
+
+import sys
+
+from distributed_drift_detection_tpu.config import RunConfig
+from distributed_drift_detection_tpu.harness.grid import run_grid
+from distributed_drift_detection_tpu.harness.plots import render_all
+
+
+def main():
+    dataset = sys.argv[1] if len(sys.argv) > 1 else "synth:rialto,seed=0"
+    base = RunConfig(dataset=dataset, results_csv="sweep_runs.csv")
+    run_grid(base, mults=[8, 16, 32], partitions=[1, 2, 4, 8], trials=3)
+    outputs = render_all(base.results_csv, "figures")
+    for name, path in outputs.items():
+        print(f"{name} -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Unbounded-stream detection with checkpoint/resume.
+
+Feeds an endless synthetic stream through the chunked engine (speculative
+window execution across chunk boundaries), checkpoints mid-stream, and
+resumes from the checkpoint — the carry is a few KB per partition.
+
+    python examples/unbounded_stream.py [total_rows]
+"""
+
+import sys
+import tempfile
+
+import numpy as np
+
+from distributed_drift_detection_tpu.engine import ChunkedDetector
+from distributed_drift_detection_tpu.io import generator_chunks
+from distributed_drift_detection_tpu.io.synth import sea_chunk
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+
+def main():
+    total = int(float(sys.argv[1])) if len(sys.argv) > 1 else 2_000_000
+    p, b, cb = 8, 1000, 50
+
+    det = ChunkedDetector(
+        build_model("centroid", ModelSpec(3, 2)),
+        partitions=p,
+        window=16,
+    )
+    chunks = generator_chunks(
+        lambda s, e: sea_chunk(seed=0, start=s, stop=e, drift_every=100_000),
+        total_rows=total, partitions=p, per_batch=b, chunk_batches=cb,
+    )
+
+    half = total // (p * b * cb) // 2
+    fed = 0
+    for i, chunk in enumerate(chunks):
+        det.feed(chunk)
+        fed += 1
+        if i + 1 == half:
+            with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+                path = f.name
+            det.save(path)
+            print(f"checkpointed after {det.batches_done} batches -> {path}")
+            det = ChunkedDetector(
+                build_model("centroid", ModelSpec(3, 2)), partitions=p, window=16
+            )
+            det.restore(path, example_chunk=chunk)
+            print("resumed from checkpoint")
+    print(f"fed {fed} chunks ({det.batches_done} batches/partition)")
+
+
+if __name__ == "__main__":
+    main()
