@@ -52,6 +52,7 @@ class ChunkedDetector:
         retrain_error_threshold: float | None = None,
         seed: int = 0,
         window: int = 1,
+        mesh=None,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -90,7 +91,21 @@ class ChunkedDetector:
             def run_chunk(carry: LoopCarry, batches: Batches):
                 return lax.scan(step, carry, batches)
 
-        self._run_chunk = jax.jit(jax.vmap(run_chunk))
+        # ``mesh``: shard the partition axis over devices, exactly like the
+        # one-shot mesh runner (parallel.mesh) — every carry/chunk/flag leaf
+        # is partition-major, so one sharding prefix covers the trees.
+        self._sharding = None
+        if mesh is not None:
+            from ..parallel.mesh import partition_sharding
+
+            self._sharding = partition_sharding(mesh, partitions)
+            self._run_chunk = jax.jit(
+                jax.vmap(run_chunk),
+                in_shardings=(self._sharding, self._sharding),
+                out_shardings=(self._sharding, self._sharding),
+            )
+        else:
+            self._run_chunk = jax.jit(jax.vmap(run_chunk))
         self._seed = seed
         self.carry: LoopCarry | None = None
         self.batches_done = 0
@@ -118,7 +133,12 @@ class ChunkedDetector:
         Does not block: results are JAX async values, so the caller can
         prefetch/construct the next chunk while the device runs.
         """
-        chunk = jax.tree.map(jnp.asarray, chunk)
+        put = (
+            (lambda x: jax.device_put(x, self._sharding))
+            if self._sharding is not None
+            else jnp.asarray
+        )
+        chunk = jax.tree.map(put, chunk)
         if self.carry is None:
             self.carry = self._init_carry(chunk)
             chunk = jax.tree.map(lambda x: x[:, 1:], chunk)

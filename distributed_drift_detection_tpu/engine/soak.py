@@ -116,6 +116,7 @@ def make_soak_runner(
     drift_every: int,
     generator: str = "prototypes",
     features: int | None = None,
+    mesh=None,
 ):
     """Build ``run(key) -> SoakResult``: the full soak as ONE device program.
 
@@ -123,7 +124,9 @@ def make_soak_runner(
     rows, drift every ``drift_every`` rows); total workload is
     ``partitions * num_batches * per_batch`` rows with zero host feeding.
     ``jax.jit`` the result; flags come back as ``[P, NB-1]`` like every other
-    engine (batch 0 seeds ``batch_a``).
+    engine (batch 0 seeds ``batch_a``). With ``mesh`` the partition axis is
+    device-sharded (generation included — each device synthesises only its
+    own partitions' rows); without it, jit the returned function yourself.
     """
     try:
         gen, default_f = _GENERATORS[generator]
@@ -169,9 +172,28 @@ def make_soak_runner(
         _, flags = lax.scan(scan_step, carry, jnp.arange(1, nb, dtype=jnp.int32))
         return flags
 
+    if mesh is not None:
+        from ..parallel.mesh import partition_sharding
+
+        sh = partition_sharding(mesh, p)
+    else:
+        sh = None
+
     def run(key: jax.Array) -> SoakResult:
         keys = jax.random.split(key, p)
-        flags = jax.vmap(run_partition)(jnp.arange(p), keys)
+        parts = jnp.arange(p)
+        if sh is not None:
+            keys = jax.lax.with_sharding_constraint(keys, sh)
+            parts = jax.lax.with_sharding_constraint(parts, sh)
+        flags = jax.vmap(run_partition)(parts, keys)
         return SoakResult(flags=flags, rows_processed=p * nb * b)
 
+    if sh is not None:
+        return jax.jit(
+            run,
+            out_shardings=SoakResult(
+                flags=FlagRows(*(sh,) * len(FlagRows._fields)),
+                rows_processed=None,
+            ),
+        )
     return run

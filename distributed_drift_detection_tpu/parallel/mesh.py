@@ -58,6 +58,20 @@ def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (PARTITION_AXIS,))
 
 
+def partition_sharding(mesh: Mesh, partitions: int | None = None) -> NamedSharding:
+    """The canonical partition-axis sharding for ``mesh``.
+
+    When ``partitions`` is given, validates divisibility by the mesh size —
+    the invariant every partition-major engine shares.
+    """
+    if partitions is not None and partitions % mesh.devices.size:
+        raise ValueError(
+            f"{partitions} partitions not divisible by the "
+            f"{mesh.devices.size}-device mesh"
+        )
+    return NamedSharding(mesh, P(PARTITION_AXIS))
+
+
 class MeshRunResult(NamedTuple):
     flags: FlagRows  # leaves [P, NB-1]
     drift_vote: jax.Array  # [NB-1] f32: fraction of partitions flagging change

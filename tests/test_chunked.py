@@ -178,3 +178,26 @@ def test_chunked_window_checkpoint_resume():
     got = type(want)(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
     for a, c in zip(want, got):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_chunked_mesh_sharded_matches_single_device():
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh
+
+    stream = make_stream()
+    p, b = 8, 40
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model("centroid", spec)
+
+    def flags_with(mesh):
+        det = ChunkedDetector(
+            model, REF, partitions=p, seed=0, window=4, mesh=mesh
+        )
+        chunks = chunk_stream_arrays(
+            stream.X, stream.y, p, b, chunk_batches=6, shuffle_seed=11
+        )
+        return det.run(chunks)
+
+    plain = flags_with(None)
+    sharded = flags_with(make_mesh(8))
+    for a, c in zip(plain, sharded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))

@@ -52,3 +52,18 @@ def test_unknown_generator_rejected():
             partitions=2, per_batch=10, num_batches=5, drift_every=100,
             generator="nope",
         )
+
+
+def test_soak_mesh_sharded_matches_single_device():
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh
+
+    single = _run(partitions=8)
+    run = make_soak_runner(
+        build_model("centroid", ModelSpec(8, 8)),
+        partitions=8, per_batch=100, num_batches=100, drift_every=1000,
+        mesh=make_mesh(8),
+    )
+    sharded = run(jax.random.key(0))
+    for a, c in zip(single.flags, sharded.flags):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    assert len(sharded.flags.change_global.sharding.device_set) == 8
