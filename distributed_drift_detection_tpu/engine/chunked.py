@@ -133,12 +133,10 @@ class ChunkedDetector:
         Does not block: results are JAX async values, so the caller can
         prefetch/construct the next chunk while the device runs.
         """
-        put = (
-            (lambda x: jax.device_put(x, self._sharding))
-            if self._sharding is not None
-            else jnp.asarray
-        )
-        chunk = jax.tree.map(put, chunk)
+        if self._sharding is not None:
+            chunk = jax.device_put(chunk, self._sharding)
+        else:
+            chunk = jax.tree.map(jnp.asarray, chunk)
         if self.carry is None:
             self.carry = self._init_carry(chunk)
             chunk = jax.tree.map(lambda x: x[:, 1:], chunk)

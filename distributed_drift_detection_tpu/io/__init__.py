@@ -1,4 +1,4 @@
-from .feeder import chunk_stream_arrays, generator_chunks
+from .feeder import chunk_stream_arrays, generator_chunks, prefetch_chunks
 from .stream import (
     StreamData,
     load_csv,
@@ -20,6 +20,7 @@ from .synth import (
 __all__ = [
     "chunk_stream_arrays",
     "generator_chunks",
+    "prefetch_chunks",
     "StreamData",
     "load_csv",
     "load_stream",

@@ -12,6 +12,8 @@ double-buffering called for by SURVEY.md §7 "host-feed bandwidth").
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Iterator
 
 import numpy as np
@@ -57,3 +59,61 @@ def generator_chunks(
         e = min(s + rows_per_chunk, total_rows)
         X, y = chunk_fn(s, e)
         yield stripe_chunk(X, y, s, p, b, cb, shuffle_seed)
+
+
+class _Stop:
+    pass
+
+
+def prefetch_chunks(chunks: Iterator, depth: int = 2) -> Iterator:
+    """Run a chunk iterator in a background thread, ``depth`` chunks ahead.
+
+    JAX async dispatch already overlaps *device* compute with the caller's
+    *next* host-side chunk assembly — but the assembly itself (CSV parse,
+    generator math, striping) runs serially with the feed loop's Python.
+    This wrapper moves it to a producer thread with a bounded queue, so host
+    construction of chunk N+k proceeds while the main thread is feeding
+    chunk N (the double-buffered feed of SURVEY.md §7 "host-feed
+    bandwidth", generalized to depth-k).
+
+    Exceptions in the producer propagate to the consumer. Abandoning the
+    returned iterator (break / exception / GC) stops the producer thread
+    promptly — its queue puts are timeout-guarded against a cancellation
+    event that the consumer sets on close, so no chunks stay pinned.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for item in chunks:
+                if not put(item):
+                    return
+            put(_Stop)
+        except BaseException as e:  # propagate into the consumer
+            put(e)
+
+    threading.Thread(target=produce, daemon=True).start()
+
+    def consume():
+        try:
+            while True:
+                item = q.get()
+                if item is _Stop:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    return consume()

@@ -162,7 +162,7 @@ def make_mesh_runner(
     if mesh is None:
         return jax.jit(run)
 
-    data_sharding = NamedSharding(mesh, P(PARTITION_AXIS))
+    data_sharding = partition_sharding(mesh)
     replicated = NamedSharding(mesh, P())
     if indexed:
         in_batches = IndexedBatches(
@@ -189,7 +189,7 @@ def shard_batches(batches, keys: jax.Array, mesh: Mesh | None):
     """
     if mesh is None:
         return jax.device_put(batches), jax.device_put(keys)
-    sh = NamedSharding(mesh, P(PARTITION_AXIS))
+    sh = partition_sharding(mesh)
     if isinstance(batches, IndexedBatches):
         rep = NamedSharding(mesh, P())
         placed = IndexedBatches(

@@ -13,7 +13,7 @@ import tempfile
 import numpy as np
 
 from distributed_drift_detection_tpu.engine import ChunkedDetector
-from distributed_drift_detection_tpu.io import generator_chunks
+from distributed_drift_detection_tpu.io import generator_chunks, prefetch_chunks
 from distributed_drift_detection_tpu.io.synth import sea_chunk
 from distributed_drift_detection_tpu.models import ModelSpec, build_model
 
@@ -27,9 +27,11 @@ def main():
         partitions=p,
         window=16,
     )
-    chunks = generator_chunks(
-        lambda s, e: sea_chunk(seed=0, start=s, stop=e, drift_every=100_000),
-        total_rows=total, partitions=p, per_batch=b, chunk_batches=cb,
+    chunks = prefetch_chunks(  # background-thread host assembly (depth 2)
+        generator_chunks(
+            lambda s, e: sea_chunk(seed=0, start=s, stop=e, drift_every=100_000),
+            total_rows=total, partitions=p, per_batch=b, chunk_batches=cb,
+        )
     )
 
     half = total // (p * b * cb) // 2

@@ -85,3 +85,65 @@ def test_striping_rectangular_equal_shapes():
     # 103/4 → 26 rows max per partition → 3 batches of 10
     assert b.X.shape == (4, 3, 10, 2)
     assert np.asarray(b.valid).sum() == 103
+
+
+def test_prefetch_chunks_transparent():
+    """prefetch_chunks yields the same chunks in order, and propagates
+    producer exceptions."""
+    from distributed_drift_detection_tpu.io import (
+        generator_chunks,
+        prefetch_chunks,
+    )
+    from distributed_drift_detection_tpu.io.synth import sea_chunk
+
+    def chunks():
+        return generator_chunks(
+            lambda s, e: sea_chunk(seed=3, start=s, stop=e, drift_every=500),
+            total_rows=20_000, partitions=4, per_batch=50, chunk_batches=5,
+        )
+
+    plain = list(chunks())
+    fetched = list(prefetch_chunks(chunks(), depth=3))
+    assert len(plain) == len(fetched)
+    for a, b in zip(plain, fetched):
+        for la, lb in zip(a, b):
+            np.testing.assert_array_equal(la, lb)
+
+    def boom():
+        yield plain[0]
+        raise RuntimeError("producer failed")
+
+    it = prefetch_chunks(boom())
+    next(it)
+    try:
+        next(it)
+    except RuntimeError as e:
+        assert "producer failed" in str(e)
+    else:
+        raise AssertionError("expected producer exception to propagate")
+
+
+def test_prefetch_chunks_abandoned_consumer_stops_producer():
+    import threading
+    import time
+
+    from distributed_drift_detection_tpu.io import prefetch_chunks
+
+    produced = []
+
+    def endless():
+        i = 0
+        while True:
+            produced.append(i)
+            yield i
+            i += 1
+
+    before = threading.active_count()
+    it = prefetch_chunks(endless(), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon: must release the parked producer thread
+    time.sleep(0.6)
+    assert threading.active_count() <= before + 1  # thread gone (or finishing)
+    n = len(produced)
+    time.sleep(0.4)
+    assert len(produced) == n  # production actually stopped
