@@ -45,7 +45,7 @@ from .parallel.mesh import (
     unpack_flags,
 )
 from .results import append_result
-from .utils.timing import PhaseTimer
+from .utils.timing import PhaseTimer, maybe_trace
 
 
 class PreparedRun(NamedTuple):
@@ -159,7 +159,7 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     start = time.perf_counter()
     with timer.phase("upload"):
         dev_batches, dev_keys = shard_batches(batches, keys, mesh)
-    with timer.phase("detect"):
+    with timer.phase("detect"), maybe_trace(cfg.trace_dir):
         out = runner(dev_batches, dev_keys)
         jax.block_until_ready(out)
     with timer.phase("collect"):

@@ -117,6 +117,10 @@ class RunConfig:
     # on the tiny flag table), off by default for exact reference parity of
     # the timed span.
     validate: bool = False
+    # When set, wrap the detection phase in a jax.profiler trace written to
+    # this directory (aux subsystem: tracing/profiling, SURVEY.md §5) —
+    # inspect with TensorBoard or Perfetto.
+    trace_dir: str = ""
 
     # --- bookkeeping (recorded verbatim into the results CSV, C11 parity) ---
     app_name: str = ""

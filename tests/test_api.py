@@ -82,3 +82,18 @@ def test_linear_model_end_to_end(tmp_path):
     res = run(base_cfg(tmp_path, model="linear", shuffle_batches=True))
     assert res.metrics.num_detections >= 25
     assert res.metrics.mean_delay_rows < 150
+
+
+def test_trace_dir_writes_profile(tmp_path):
+    """RunConfig(trace_dir=...) wraps detect in a jax.profiler trace."""
+    d = str(tmp_path / "trace")
+    run(
+        base_cfg(
+            tmp_path, mult_data=2, partitions=2, model="centroid",
+            results_csv="", trace_dir=d,
+        )
+    )
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs
+    ]
+    assert found, "profiler trace directory is empty"
