@@ -1,4 +1,9 @@
-from .feeder import chunk_stream_arrays, generator_chunks, prefetch_chunks
+from .feeder import (
+    chunk_stream_arrays,
+    csv_chunks,
+    generator_chunks,
+    prefetch_chunks,
+)
 from .stream import (
     StreamData,
     load_csv,
@@ -19,6 +24,7 @@ from .synth import (
 
 __all__ = [
     "chunk_stream_arrays",
+    "csv_chunks",
     "generator_chunks",
     "prefetch_chunks",
     "StreamData",

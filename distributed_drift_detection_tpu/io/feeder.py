@@ -117,3 +117,90 @@ def prefetch_chunks(chunks: Iterator, depth: int = 2) -> Iterator:
             stop.set()
 
     return consume()
+
+
+def csv_chunks(
+    path: str,
+    partitions: int,
+    per_batch: int,
+    chunk_batches: int,
+    *,
+    target_column: str = "target",
+    shuffle_seed: int | None = None,
+    block_bytes: int = 16 << 20,
+) -> Iterator[Batches]:
+    """Stream a CSV file from disk as striped chunks, without materialising it.
+
+    The one-shot path (``io.stream.load_csv``) parses the whole file — right
+    for the reference's scale, impossible for multi-hundred-GB streams. This
+    reader consumes the file in bounded byte blocks (carrying partial lines
+    across block edges), parses each with the native multithreaded parser
+    (``io.native.parse_block``; NumPy fallback), and yields the same
+    ``[P, CB, B]`` chunks as :func:`chunk_stream_arrays` — host memory stays
+    O(block + chunk) regardless of file size. Compose with
+    :func:`prefetch_chunks` to overlap the parse with device compute.
+
+    Labels are not re-indexed — for class labels outside ``0..C-1``, remap
+    before modelling (the one-shot loader's re-indexing needs a full pass,
+    which a stream cannot afford by design). They parse through float32
+    (exact for integers up to 2^24); larger label ids raise rather than
+    silently round.
+    """
+    p, b, cb = partitions, per_batch, chunk_batches
+    rows_per_chunk = p * b * cb
+    from .native import parse_block
+
+    with open(path, "rb") as fh:
+        header = fh.readline().decode().strip().split(",")
+        tcol = header.index(target_column)
+        cols = len(header)
+        mask = np.ones(cols, bool)
+        mask[tcol] = False
+
+        parts: list[np.ndarray] = []
+        buffered = 0
+        start_row = 0
+        carry = b""
+
+        def emit(arr_list, start, n_take):
+            data = np.concatenate(arr_list) if len(arr_list) > 1 else arr_list[0]
+            take, rest = data[:n_take], data[n_take:]
+            labels = take[:, tcol]
+            if labels.size and np.abs(labels).max() >= 2**24:
+                raise ValueError(
+                    "label ids at or above 2^24 are not exactly representable "
+                    "on the float32 parse path; re-encode the target column"
+                )
+            chunk = stripe_chunk(
+                take[:, mask],
+                labels.astype(np.int32),
+                start,
+                p, b, cb,
+                shuffle_seed,
+            )
+            return chunk, rest
+
+        while True:
+            block = fh.read(block_bytes)
+            if not block:
+                break
+            block = carry + block
+            cut = block.rfind(b"\n")
+            if cut < 0:
+                carry = block
+                continue
+            carry, block = block[cut + 1:], block[: cut + 1]
+            arr = parse_block(block, cols)
+            parts.append(arr)
+            buffered += len(arr)
+            while buffered >= rows_per_chunk:
+                chunk, rest = emit(parts, start_row, rows_per_chunk)
+                yield chunk
+                start_row += rows_per_chunk
+                parts, buffered = ([rest] if len(rest) else []), len(rest)
+        if carry:
+            parts.append(parse_block(carry, cols))
+            buffered += len(parts[-1])
+        if buffered:
+            chunk, _ = emit(parts, start_row, buffered)
+            yield chunk

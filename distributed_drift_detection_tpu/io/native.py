@@ -61,6 +61,14 @@ def _load_library() -> ctypes.CDLL | None:
         lib.ddd_csv_read.restype = ctypes.c_int64
         lib.ddd_csv_close.argtypes = [ctypes.c_void_p]
         lib.ddd_csv_close.restype = None
+        lib.ddd_parse_block.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+        ]
+        lib.ddd_parse_block.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -91,3 +99,31 @@ def load_csv_native(path: str) -> np.ndarray | None:
         return out
     finally:
         lib.ddd_csv_close(handle)
+
+
+def parse_block(block: bytes, cols: int) -> np.ndarray:
+    """Parse a block of complete CSV data rows (no header) to ``[n, cols]``
+    f32. Native multithreaded parser when available, NumPy fallback
+    otherwise; raises ``ValueError`` on malformed data either way."""
+    if not block:
+        return np.empty((0, cols), np.float32)
+    lib = _load_library()
+    if lib is not None:
+        max_rows = block.count(b"\n") + (0 if block.endswith(b"\n") else 1)
+        out = np.empty((max_rows, cols), np.float32)
+        n = lib.ddd_parse_block(
+            block,
+            len(block),
+            cols,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_rows,
+        )
+        if n >= 0:
+            return out[:n]
+        # fall through: NumPy raises with a useful message
+    import io as _io
+
+    arr = np.loadtxt(_io.BytesIO(block), delimiter=",", dtype=np.float32, ndmin=2)
+    if arr.shape[1] != cols:
+        raise ValueError(f"expected {cols} columns, got {arr.shape[1]}")
+    return arr

@@ -32,7 +32,7 @@ namespace {
 
 struct Csv {
   std::string data;
-  std::vector<const char*> starts;  // body line starts
+  std::vector<std::pair<const char*, const char*>> lines;  // body lines
   int64_t rows = 0;
   int64_t cols = 0;
 };
@@ -66,6 +66,44 @@ unsigned num_threads() {
   return t ? t : 4;
 }
 
+using Line = std::pair<const char*, const char*>;
+
+// Split [buf, end) into non-empty lines, trimming a trailing '\r' per line.
+std::vector<Line> split_lines(const char* buf, const char* end) {
+  std::vector<Line> lines;
+  for (const char* q = buf; q < end;) {
+    const char* e = static_cast<const char*>(memchr(q, '\n', end - q));
+    const char* line_end = e ? e : end;
+    if (line_end > q && *(line_end - 1) == '\r') --line_end;
+    if (line_end > q) lines.emplace_back(q, line_end);
+    if (!e) break;
+    q = e + 1;
+  }
+  return lines;
+}
+
+// Parse every line into out[i*cols ..); returns the number of malformed rows.
+int64_t parse_rows(const std::vector<Line>& lines, int64_t cols, float* out) {
+  const int64_t n = static_cast<int64_t>(lines.size());
+  unsigned T = num_threads();
+  std::atomic<int64_t> bad{0};
+  std::vector<std::thread> threads;
+  int64_t per = (n + T - 1) / T;
+  for (unsigned t = 0; t < T; ++t) {
+    int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back([&, lo, hi] {
+      for (int64_t i = lo; i < hi; ++i) {
+        const auto& ln = lines[static_cast<size_t>(i)];
+        if (!parse_line(ln.first, ln.second, out + i * cols, cols))
+          bad.fetch_add(1);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  return bad.load();
+}
+
 }  // namespace
 
 extern "C" {
@@ -93,15 +131,8 @@ void* ddd_csv_open(const char* path) {
     return nullptr;
   }
   csv->cols = 1 + std::count(base, nl, ',');
-  for (const char* q = nl + 1; q < end;) {
-    const char* e = static_cast<const char*>(memchr(q, '\n', end - q));
-    const char* line_end = e ? e : end;
-    if (line_end > q && *(line_end - 1) == '\r') --line_end;
-    if (line_end > q) csv->starts.push_back(q);
-    if (!e) break;
-    q = e + 1;
-  }
-  csv->rows = static_cast<int64_t>(csv->starts.size());
+  csv->lines = split_lines(nl + 1, end);
+  csv->rows = static_cast<int64_t>(csv->lines.size());
   return csv;
 }
 
@@ -112,31 +143,23 @@ int64_t ddd_csv_cols(void* handle) { return static_cast<Csv*>(handle)->cols; }
 // or -(number of malformed rows).
 int64_t ddd_csv_read(void* handle, float* out) {
   Csv* csv = static_cast<Csv*>(handle);
-  const char* end = csv->data.data() + csv->data.size();
-  const int64_t n = csv->rows;
-  const int64_t cols = csv->cols;
-
-  unsigned T = num_threads();
-  std::atomic<int64_t> bad{0};
-  std::vector<std::thread> threads;
-  int64_t per = (n + T - 1) / T;
-  for (unsigned t = 0; t < T; ++t) {
-    int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
-    if (lo >= hi) break;
-    threads.emplace_back([&, lo, hi] {
-      for (int64_t i = lo; i < hi; ++i) {
-        const char* s = csv->starts[static_cast<size_t>(i)];
-        const char* e = static_cast<const char*>(memchr(s, '\n', end - s));
-        if (!e) e = end;
-        if (e > s && *(e - 1) == '\r') --e;
-        if (!parse_line(s, e, out + i * cols, cols)) bad.fetch_add(1);
-      }
-    });
-  }
-  for (auto& th : threads) th.join();
-  return -bad.load();
+  return -parse_rows(csv->lines, csv->cols, out);
 }
 
 void ddd_csv_close(void* handle) { delete static_cast<Csv*>(handle); }
+
+// Parse a block of complete newline-separated data rows (no header) into
+// out[max_rows*cols]. The block need not end with '\n'. Returns the number
+// of rows parsed (>= 0), or -1 on any malformed row, or -2 if the block
+// holds more than max_rows rows. Multithreaded like ddd_csv_read; used by
+// the streaming ingest path (io.feeder.csv_chunks), which reads a large
+// file in bounded blocks instead of materialising it.
+int64_t ddd_parse_block(const char* buf, int64_t len, int64_t cols,
+                        float* out, int64_t max_rows) {
+  auto lines = split_lines(buf, buf + len);
+  const int64_t n = static_cast<int64_t>(lines.size());
+  if (n > max_rows) return -2;
+  return parse_rows(lines, cols, out) ? -1 : n;
+}
 
 }  // extern "C"

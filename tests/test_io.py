@@ -147,3 +147,60 @@ def test_prefetch_chunks_abandoned_consumer_stops_producer():
     n = len(produced)
     time.sleep(0.4)
     assert len(produced) == n  # production actually stopped
+
+
+def test_csv_chunks_equals_in_memory_chunking(tmp_path):
+    """Streaming CSV ingest yields bit-identical chunks to loading the file
+    and chunking in memory, across block-boundary carries and the padded
+    final partial chunk."""
+    from distributed_drift_detection_tpu.io import (
+        chunk_stream_arrays,
+        csv_chunks,
+    )
+
+    rng = np.random.default_rng(5)
+    n, f = 2357, 4  # deliberately not a multiple of any chunk geometry
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = rng.integers(0, 7, n).astype(np.int32)
+    path = tmp_path / "s.csv"
+    cols = [f"f{i}" for i in range(2)] + ["target"] + [f"g{i}" for i in range(2)]
+    with open(path, "w") as fh:
+        fh.write(",".join(cols) + "\n")
+        for i in range(n):
+            row = [*X[i, :2], float(y[i]), *X[i, 2:]]
+            fh.write(",".join(repr(float(v)) for v in row) + "\n")
+
+    kw = dict(partitions=4, per_batch=25, chunk_batches=3, shuffle_seed=9)
+    want = list(chunk_stream_arrays(X, y, **kw))
+    # Tiny block size forces many partial-line carries.
+    got = list(csv_chunks(str(path), 4, 25, 3, shuffle_seed=9, block_bytes=999))
+    assert len(want) == len(got)
+    for a, c in zip(want, got):
+        for la, lb in zip(a, c):
+            np.testing.assert_array_equal(la, lb)
+
+
+def test_csv_chunks_malformed_raises(tmp_path):
+    from distributed_drift_detection_tpu.io import csv_chunks
+
+    path = tmp_path / "bad.csv"
+    path.write_text("a,target\n1.0,0\nnope,1\n")
+    with pytest.raises(ValueError):
+        list(csv_chunks(str(path), 1, 2, 1))
+
+
+def test_parse_block_native_matches_numpy():
+    from distributed_drift_detection_tpu.io.native import (
+        native_available,
+        parse_block,
+    )
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(300, 5)).astype(np.float32)
+    block = "\n".join(
+        ",".join(repr(float(v)) for v in row) for row in arr
+    ).encode()
+    out = parse_block(block, 5)
+    np.testing.assert_allclose(out, arr, rtol=1e-6)
