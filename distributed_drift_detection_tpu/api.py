@@ -120,6 +120,13 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     spec = ModelSpec(stream.num_features, stream.num_classes)
     n_dev = cfg.mesh_devices or len(jax.devices())
     n_dev = min(n_dev, len(jax.devices()))
+    if cfg.model == "rf":
+        # The host-callback RF is a single-device parity path (models/rf.py):
+        # inside a multi-device sharded program the per-device callbacks
+        # serialize on the host while the other participants block at the
+        # drift-vote all-reduce — XLA's collective rendezvous then aborts
+        # the process. Run it unsharded (vmap over partitions still applies).
+        n_dev = 1
     # The mesh size must divide the partition count; fall back toward fewer
     # devices (the reference likewise ran any instance count on whatever
     # cluster existed).

@@ -96,7 +96,10 @@ class ChunkedDetector:
         # is partition-major, so one sharding prefix covers the trees.
         self._sharding = None
         if mesh is not None:
+            from ..models.base import require_shardable
             from ..parallel.mesh import partition_sharding
+
+            require_shardable(model, mesh)
 
             self._sharding = partition_sharding(mesh, partitions)
             self._run_chunk = jax.jit(

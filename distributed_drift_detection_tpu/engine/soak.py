@@ -173,8 +173,10 @@ def make_soak_runner(
         return flags
 
     if mesh is not None:
+        from ..models.base import require_shardable
         from ..parallel.mesh import partition_sharding
 
+        require_shardable(model, mesh)
         sh = partition_sharding(mesh, p)
     else:
         sh = None

@@ -36,22 +36,28 @@ def _load_library() -> ctypes.CDLL | None:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except (subprocess.SubprocessError, OSError):
-                return None
+        # Always invoke make: its .cc dependency makes this a cheap no-op
+        # when the library is current, and rebuilds a stale .so whose symbol
+        # set predates this binding (binding such a library would raise).
+        try:
+            subprocess.run(
+                ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError):
+            pass  # no toolchain / read-only checkout: try the existing .so
         if not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
+        try:
+            lib.ddd_parse_block  # noqa: B018 — probe the newest symbol
+        except AttributeError:
+            return None  # stale library that make could not refresh
         lib.ddd_csv_open.argtypes = [ctypes.c_char_p]
         lib.ddd_csv_open.restype = ctypes.c_void_p
         for fn in (lib.ddd_csv_rows, lib.ddd_csv_cols):

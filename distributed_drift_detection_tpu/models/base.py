@@ -31,6 +31,22 @@ class Model(NamedTuple):
     init: Callable[[jax.Array], Any]
     fit: Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Any]
     predict: Callable[[Any, jax.Array], jax.Array]
+    # True for models whose predict crosses to the host (jax.pure_callback,
+    # e.g. models/rf.py). Such models must not run inside a device-sharded
+    # program: the per-device callbacks serialize on the host while other
+    # mesh participants block at the drift-vote all-reduce, aborting the
+    # process. Engines reject mesh + host_callback combinations.
+    host_callback: bool = False
+
+
+def require_shardable(model: Model, mesh) -> None:
+    """Reject host-callback models combined with a device mesh (see above)."""
+    if mesh is not None and model.host_callback:
+        raise ValueError(
+            f"model {model.name!r} uses a host callback and cannot run in a "
+            "device-sharded program (host callbacks deadlock the collective "
+            "rendezvous); drop the mesh or pick an on-device model"
+        )
 
 
 class ModelSpec(NamedTuple):

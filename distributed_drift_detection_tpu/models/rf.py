@@ -163,4 +163,4 @@ def make_rf(
             vmap_method="sequential",
         )
 
-    return Model("rf", init, fit, predict)
+    return Model("rf", init, fit, predict, host_callback=True)

@@ -116,6 +116,9 @@ def make_mesh_runner(
     :class:`IndexedBatches` (compressed stream: row table replicated across
     the mesh, index planes sharded; requires ``window > 1``).
     """
+    from ..models.base import require_shardable
+
+    require_shardable(model, mesh)
     if indexed and window <= 1:
         raise ValueError("indexed batches require the window engine (window > 1)")
     if ddm_impl != "xla" and window <= 1:

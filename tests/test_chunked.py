@@ -201,3 +201,12 @@ def test_chunked_mesh_sharded_matches_single_device():
     sharded = flags_with(make_mesh(8))
     for a, c in zip(plain, sharded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_host_callback_model_rejected_on_mesh():
+    from distributed_drift_detection_tpu.models.rf import make_rf
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh
+
+    rf = make_rf(ModelSpec(4, 3), batch_size=10)
+    with pytest.raises(ValueError, match="host callback"):
+        ChunkedDetector(rf, REF, partitions=8, mesh=make_mesh(8))

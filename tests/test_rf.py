@@ -67,3 +67,19 @@ def test_rf_window_engine(stream):
     np.testing.assert_array_equal(
         seq.flags.change_global, win.flags.change_global
     )
+
+
+def test_rf_runs_unsharded_on_multidevice_host():
+    """model='rf' must not build a sharded mesh program: host callbacks
+    inside an SPMD computation deadlock the CPU collective rendezvous (one
+    device thread blocks in the callback while the rest wait at the
+    drift-vote all-reduce). prepare() pins rf to one device."""
+    from distributed_drift_detection_tpu import RunConfig, run
+    from distributed_drift_detection_tpu.api import prepare
+
+    cfg = RunConfig(dataset="synth:rialto,seed=0", mult_data=0.2, partitions=8,
+                    per_batch=50, model="rf", rf_estimators=5, results_csv="")
+    prep = prepare(cfg)
+    assert prep.mesh is None
+    res = run(cfg)
+    assert res.metrics.num_detections >= 0  # completes without deadlock
