@@ -66,9 +66,10 @@ class PreparedRun(NamedTuple):
 _RUNNER_CACHE: OrderedDict = OrderedDict()
 
 
-def _cached_runner(cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool):
+def _cached_runner(
+    cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool, model
+):
     def build():
-        model = build_model(cfg.model, spec, cfg)
         mesh = make_mesh(n_dev) if n_dev > 1 else None
         runner = make_mesh_runner(
             model,
@@ -82,8 +83,8 @@ def _cached_runner(cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool):
         )
         return runner, mesh
 
-    if cfg.model == "rf":
-        return build()
+    if model.host_callback:
+        return build()  # never cached: closures pin host-side fitted state
     key = (
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
@@ -118,21 +119,28 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
     )
     spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model(cfg.model, spec, cfg)
     n_dev = cfg.mesh_devices or len(jax.devices())
     n_dev = min(n_dev, len(jax.devices()))
-    if cfg.model == "rf":
-        # The host-callback RF is a single-device parity path (models/rf.py):
-        # inside a multi-device sharded program the per-device callbacks
-        # serialize on the host while the other participants block at the
-        # drift-vote all-reduce — XLA's collective rendezvous then aborts
-        # the process. Run it unsharded (vmap over partitions still applies).
+    if model.host_callback:
+        # Host-callback models are single-device-only (models/base.py
+        # require_shardable): inside a sharded program the per-device
+        # callbacks serialize on the host while the other participants block
+        # at the drift-vote all-reduce — XLA's rendezvous then aborts the
+        # process. An *explicitly requested* mesh fails loudly; the default
+        # (mesh_devices=0 = auto) quietly runs unsharded (vmap still applies).
+        if cfg.mesh_devices > 1:
+            raise ValueError(
+                f"model {cfg.model!r} uses a host callback and cannot run on "
+                f"a {cfg.mesh_devices}-device mesh; set mesh_devices=0"
+            )
         n_dev = 1
     # The mesh size must divide the partition count; fall back toward fewer
     # devices (the reference likewise ran any instance count on whatever
     # cluster existed).
     while n_dev > 1 and cfg.partitions % n_dev:
         n_dev -= 1
-    runner, mesh = _cached_runner(cfg, spec, n_dev, indexed)
+    runner, mesh = _cached_runner(cfg, spec, n_dev, indexed, model)
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
     return PreparedRun(stream, batches, runner, keys, mesh)
 

@@ -7,7 +7,8 @@ from .loop import (
     make_partition_runner,
     make_partition_step,
 )
-from .window import make_window_runner
+from .soak import SoakResult, make_soak_runner
+from .window import make_window_runner, make_window_span
 
 __all__ = [
     "Batches",
@@ -17,5 +18,8 @@ __all__ = [
     "LoopCarry",
     "make_partition_runner",
     "make_partition_step",
+    "make_soak_runner",
     "make_window_runner",
+    "make_window_span",
+    "SoakResult",
 ]

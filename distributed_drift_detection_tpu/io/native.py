@@ -39,14 +39,27 @@ def _load_library() -> ctypes.CDLL | None:
         # Always invoke make: its .cc dependency makes this a cheap no-op
         # when the library is current, and rebuilds a stale .so whose symbol
         # set predates this binding (binding such a library would raise).
+        # An inter-process file lock serializes the build — concurrent first
+        # loads (grid workers, pytest-xdist) must not dlopen a half-written
+        # .so another process is regenerating in place.
         try:
-            subprocess.run(
-                ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-                timeout=120,
+            import fcntl
+
+            lock_path = os.path.join(
+                os.path.abspath(_NATIVE_DIR), ".build.lock"
             )
-        except (subprocess.SubprocessError, OSError):
+            with open(lock_path, "w") as lock_fh:
+                fcntl.flock(lock_fh, fcntl.LOCK_EX)
+                try:
+                    subprocess.run(
+                        ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+                finally:
+                    fcntl.flock(lock_fh, fcntl.LOCK_UN)
+        except (subprocess.SubprocessError, OSError, ImportError):
             pass  # no toolchain / read-only checkout: try the existing .so
         if not os.path.exists(_LIB_PATH):
             return None

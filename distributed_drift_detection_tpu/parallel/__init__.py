@@ -1,13 +1,19 @@
+from . import multihost
 from .mesh import (
     PARTITION_AXIS,
     MeshRunResult,
     make_mesh,
     make_mesh_runner,
+    partition_sharding,
     shard_batches,
+    unpack_flags,
 )
 
 __all__ = [
     "PARTITION_AXIS",
+    "multihost",
+    "partition_sharding",
+    "unpack_flags",
     "MeshRunResult",
     "make_mesh",
     "make_mesh_runner",
