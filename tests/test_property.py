@@ -1,0 +1,131 @@
+"""Property-based tests (hypothesis): the kernels against the NumPy oracle
+and the native parser against NumPy, on adversarially-generated inputs.
+
+Shapes are held fixed inside each test so jit compiles once per test, not per
+example; hypothesis varies contents, carried state, and thresholds.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from distributed_drift_detection_tpu import DDMParams
+from distributed_drift_detection_tpu.ops import ddm_init
+from distributed_drift_detection_tpu.ops.ddm import ddm_batch, ddm_window
+
+from oracle import oracle_run_ddm
+
+B = 24  # fixed batch length → one jit compile per test
+
+
+def run_kernel(params: DDMParams, errs: np.ndarray):
+    """One fresh-state batch through the jitted kernel."""
+    jit_batch = jax.jit(lambda s, e: ddm_batch(s, e, jnp.ones(B, bool), params))
+    return jit_batch(ddm_init(), jnp.asarray(errs))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    data=st.data(),
+    err_p=st.floats(0.0, 1.0),
+    min_n=st.integers(1, 6),
+    warn=st.floats(0.1, 2.0),
+    out=st.floats(0.5, 4.0),
+)
+def test_ddm_batch_matches_oracle(data, err_p, min_n, warn, out):
+    """ddm_batch == the sequential oracle for arbitrary error patterns,
+    thresholds, and warm-up lengths (no carried state)."""
+    if out < warn:
+        warn, out = out, warn
+    params = DDMParams(min_num_instances=min_n, warning_level=warn,
+                       out_control_level=out)
+    errs = np.asarray(
+        data.draw(st.lists(st.sampled_from([0.0, 1.0]), min_size=B, max_size=B)),
+        np.float32,
+    )
+    # Inject structure: a clean run then errors fires realistic patterns.
+    if err_p < 0.3:
+        k = int(err_p * 3 * B)
+        errs = np.concatenate([np.zeros(B - k, np.float32),
+                               np.ones(k, np.float32)])
+
+    _, res = run_kernel(params, errs)
+    rows = np.arange(B)
+    (wl, _, cl, _), _ = oracle_run_ddm(
+        errs, rows, None, min_num_instances=min_n, warning_level=warn,
+        out_control_level=out,
+    )
+    assert int(res.first_change) == cl
+    assert int(res.first_warning) == wl
+
+
+@settings(max_examples=20, deadline=None)
+@given(data=st.data())
+def test_ddm_window_matches_chained_batches(data):
+    """ddm_window over [W, B] == W sequential ddm_batch calls with threaded
+    state, for every batch up to (and including) the first change."""
+    w = 5
+    params = DDMParams()
+    errs = np.asarray(
+        data.draw(
+            st.lists(
+                st.floats(0.0, 1.0).map(lambda p: 1.0 if p > 0.85 else 0.0),
+                min_size=w * B, max_size=w * B,
+            )
+        ),
+        np.float32,
+    ).reshape(w, B)
+    valid = np.ones((w, B), bool)
+
+    end_w, res_w = jax.jit(lambda s, e, v: ddm_window(s, e, v, params))(
+        ddm_init(), jnp.asarray(errs), jnp.asarray(valid)
+    )
+    st_ = ddm_init()
+    jit_b = jax.jit(lambda s, e: ddm_batch(s, e, jnp.ones(B, bool), params))
+    stop = w
+    for k in range(w):
+        st_, rb = jit_b(st_, jnp.asarray(errs[k]))
+        if k <= stop:
+            assert int(res_w.first_change[k]) == int(rb.first_change), k
+            assert int(res_w.first_warning[k]) == int(rb.first_warning), k
+        if stop == w and int(rb.first_change) >= 0:
+            stop = k
+    if stop == w:
+        for a, b in zip(end_w, st_):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    vals=st.lists(
+        st.floats(allow_nan=False, allow_infinity=False,
+                  min_value=-1e30, max_value=1e30),
+        min_size=1, max_size=120,
+    ),
+    cols=st.integers(1, 6),
+    crlf=st.booleans(),
+    trailing_newline=st.booleans(),
+)
+def test_native_parse_block_matches_numpy(vals, cols, crlf, trailing_newline):
+    from distributed_drift_detection_tpu.io.native import (
+        native_available,
+        parse_block,
+    )
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    n = (len(vals) // cols) * cols
+    if n == 0:
+        return
+    # Round through f32 first so the written decimal is exactly representable
+    # and both parsers (from_chars-double→f32 and NumPy) agree bit-for-bit.
+    arr = np.asarray(vals[:n], np.float32).reshape(-1, cols)
+    eol = "\r\n" if crlf else "\n"
+    text = eol.join(",".join(repr(float(v)) for v in row) for row in arr)
+    if trailing_newline:
+        text += eol
+    out = parse_block(text.encode(), cols)
+    np.testing.assert_array_equal(out, arr)
