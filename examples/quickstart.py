@@ -7,7 +7,10 @@ Equivalent of executing the reference's ``DDM_Process.py`` once
     python examples/quickstart.py [dataset.csv] [mult] [partitions]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
 
 from distributed_drift_detection_tpu import RunConfig, run
 

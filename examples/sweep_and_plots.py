@@ -7,7 +7,10 @@ crash-recovery of ``harness.grid``).
     python examples/sweep_and_plots.py [dataset.csv]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
 
 from distributed_drift_detection_tpu.config import RunConfig
 from distributed_drift_detection_tpu.harness.grid import run_grid

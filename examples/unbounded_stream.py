@@ -7,7 +7,11 @@ resumes from the checkpoint — the carry is a few KB per partition.
     python examples/unbounded_stream.py [total_rows]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
+
 import tempfile
 
 import numpy as np
