@@ -125,7 +125,7 @@ def main() -> None:
         model="centroid",  # closed-form fit; the RF-equivalent flagship
         results_csv="",
     )
-    stream, batches, runner, keys, mesh = prepare(cfg)
+    stream, batches, runner, keys, mesh = prepare(cfg)[:5]
 
     # Warm-ups: compile once on the real shapes, then once more to flush any
     # remaining one-time device/tunnel setup out of the timed region.

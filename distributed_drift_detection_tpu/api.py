@@ -28,7 +28,7 @@ from typing import NamedTuple
 import jax
 import numpy as np
 
-from .config import RunConfig, host_shuffle_seed
+from .config import RunConfig, auto_window, host_shuffle_seed, replace
 from .engine.loop import FlagRows
 from .io.stream import (
     StreamData,
@@ -56,6 +56,7 @@ class PreparedRun(NamedTuple):
     runner: object  # jitted (batches, keys) -> MeshRunResult
     keys: jax.Array
     mesh: object  # jax.sharding.Mesh | None
+    config: RunConfig  # the resolved config (window=0 auto already applied)
 
 
 # Compiled-runner LRU: repeated run()/prepare() calls with the same static
@@ -113,6 +114,8 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # Streams synthesized by duplication keep a compressed (row table + index
     # planes) form; ship that across the host→device link instead of the
     # materialized stream — identical flags, ~14× less transfer at mult=512.
+    # window == 0 → auto-size from the stream's planted drift spacing.
+    cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
     indexed = stream.src is not None and cfg.window > 1
     striper = stripe_partitions_indexed if indexed else stripe_partitions
     batches = striper(
@@ -142,7 +145,7 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
         n_dev -= 1
     runner, mesh = _cached_runner(cfg, spec, n_dev, indexed, model)
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
-    return PreparedRun(stream, batches, runner, keys, mesh)
+    return PreparedRun(stream, batches, runner, keys, mesh, cfg)
 
 
 class RunResult(NamedTuple):
@@ -168,7 +171,8 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
 
     with timer.phase("prepare"):
         prep = prepare(cfg, stream)
-    stream, batches, runner, keys, mesh = prep
+    stream, batches, runner, keys, mesh = prep[:5]
+    cfg = prep.config  # window=0 auto already resolved by prepare()
 
     # --- the reference's Final Time span starts here (:224) ---
     start = time.perf_counter()

@@ -89,10 +89,12 @@ class RunConfig:
     # >1 commits up to the first in-window change and replays the rest —
     # identical flags for deterministic-fit models (majority/centroid/linear),
     # ~window× fewer sequential steps. 16 balances speculation waste
-    # (~1 window per drift) vs step size. Caveat: the key-consuming 'mlp' fit
-    # draws its init keys per *window*, not per batch, so its flags are
-    # seed-equivalent but not bit-equal across different window values — pin
-    # window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
+    # (~1 window per drift) vs step size. 0 = auto: size the window to the
+    # stream's planted drift spacing (one window per per-partition concept,
+    # clamped to [4, 64]; see config.auto_window). Caveat: the key-consuming
+    # 'mlp' fit draws its init keys per *window*, not per batch, so its flags
+    # are seed-equivalent but not bit-equal across different window values —
+    # pin window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
     window: int = 16
     # DDM window-statistic implementation: 'xla' (cumsum + associative_scan)
     # or 'pallas' (ops/ddm_pallas.py — the whole statistic fused into one
@@ -137,6 +139,26 @@ class RunConfig:
 
 def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
     return dataclasses.replace(cfg, **kw)
+
+
+def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:
+    """Resolve ``window == 0`` from stream geometry.
+
+    The speculative engine's sequential-step count is ≈ NB/W + drifts, so W
+    gains nothing past the per-partition drift spacing (a window then spans
+    a whole concept and every drift costs its replay regardless). Pick the
+    power of two nearest that spacing, clamped to [4, 64] (tiny windows
+    forfeit the batching win; huge ones waste speculation and VMEM).
+    """
+    if cfg.window:
+        return cfg.window
+    bpc = dist_between_changes / max(cfg.partitions * cfg.per_batch, 1)
+    if bpc <= 0:
+        return 16
+    import math
+
+    w = 1 << (round(math.log2(bpc)) if bpc > 1 else 0)
+    return int(min(64, max(4, w)))
 
 
 def host_shuffle_seed(cfg: RunConfig) -> int | None:

@@ -69,6 +69,12 @@ class ChunkedDetector:
         # (keys split per window vs per batch).
         self.model = model
         self.partitions = partitions
+        if window == 0:
+            raise ValueError(
+                "window=0 (auto) needs stream geometry the chunked engine "
+                "does not have; pass an explicit width (config.auto_window "
+                "can compute one from a known drift spacing)"
+            )
         if window > 1:
             from .window import make_window_span
 

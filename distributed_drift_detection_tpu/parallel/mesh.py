@@ -119,6 +119,11 @@ def make_mesh_runner(
     from ..models.base import require_shardable
 
     require_shardable(model, mesh)
+    if window == 0:
+        raise ValueError(
+            "window=0 (auto) needs stream geometry and is resolved by "
+            "api.prepare (config.auto_window); pass an explicit width here"
+        )
     if indexed and window <= 1:
         raise ValueError("indexed batches require the window engine (window > 1)")
     if ddm_impl != "xla" and window <= 1:

@@ -97,3 +97,13 @@ def test_trace_dir_writes_profile(tmp_path):
         os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs
     ]
     assert found, "profiler trace directory is empty"
+
+
+def test_auto_window_resolves_from_stream_geometry(tmp_path):
+    """window=0 sizes the speculative window to the planted drift spacing
+    and records the resolved value in the result config."""
+    res = run(base_cfg(tmp_path, mult_data=8, partitions=8, model="centroid",
+                       results_csv="", window=0))
+    # outdoorStream ×8: dist=800 rows; 8 partitions × per_batch 50 → bpc=2 → 4
+    assert res.config.window == 4
+    assert res.metrics.num_detections > 0
