@@ -125,7 +125,10 @@ def main() -> None:
         model="centroid",  # closed-form fit; the RF-equivalent flagship
         results_csv="",
     )
-    stream, batches, runner, keys, mesh = prepare(cfg)[:5]
+    prep = prepare(cfg)
+    stream, batches, runner, keys, mesh = (
+        prep.stream, prep.batches, prep.runner, prep.keys, prep.mesh
+    )
 
     # Warm-ups: compile once on the real shapes, then once more to flush any
     # remaining one-time device/tunnel setup out of the timed region.

@@ -1,0 +1,57 @@
+"""Command-line entry point mirroring the reference's argv mode.
+
+The reference script accepts (commented-out but documented, README.md:11)
+positional arguments ``URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA``
+(``DDM_Process.py:15-21``), which ``run_experiments.sh`` passes. Same
+contract here — the Spark-only knobs are recorded verbatim into the results
+CSV for table parity — plus an optional trailing ``DATASET`` (the reference
+requires editing the script per dataset, ``README.md:12``; quirk #5 fixed):
+
+    python -m distributed_drift_detection_tpu \\
+        jax://local 16 8g 4 "$(date | sed 's/ /_/g')" 512 outdoorStream.csv
+
+With no arguments, runs the module-default config like executing the
+reference script unedited.
+"""
+
+import sys
+
+from .api import run
+from .config import RunConfig
+
+
+_USAGE = (
+    "usage: python -m distributed_drift_detection_tpu "
+    "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]"
+)
+
+
+def main(argv: list[str]) -> None:
+    kw = {}
+    if argv and len(argv) not in (6, 7):
+        raise SystemExit(_USAGE)
+    if argv:
+        try:
+            kw = dict(
+                url=argv[0],
+                partitions=int(argv[1]),  # reference INSTANCES
+                memory=argv[2],
+                cores=int(argv[3]),
+                time_string=argv[4],
+                mult_data=float(argv[5]),
+            )
+        except ValueError as e:
+            raise SystemExit(f"{_USAGE}\n({e})") from None
+        if len(argv) == 7:
+            kw["dataset"] = argv[6]
+    res = run(RunConfig(**kw))
+    m = res.metrics
+    print(
+        f"rows={res.stream.num_rows} detections={m.num_detections} "
+        f"mean_delay_rows={m.mean_delay_rows:.1f} "
+        f"final_time={res.total_time:.3f}s"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -171,7 +171,9 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
 
     with timer.phase("prepare"):
         prep = prepare(cfg, stream)
-    stream, batches, runner, keys, mesh = prep[:5]
+    stream, batches, runner, keys, mesh = (
+        prep.stream, prep.batches, prep.runner, prep.keys, prep.mesh
+    )
     cfg = prep.config  # window=0 auto already resolved by prepare()
 
     # --- the reference's Final Time span starts here (:224) ---

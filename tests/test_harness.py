@@ -79,3 +79,28 @@ def test_render_all_figures(tmp_path):
     artifacts = render_all(base.results_csv, str(tmp_path / "figs"))
     assert "speedup.pdf" in artifacts
     assert (tmp_path / "figs" / "delay_pct.pdf").exists()
+
+
+def test_argv_entry_point_reference_contract(tmp_path, monkeypatch, capsys):
+    """python -m distributed_drift_detection_tpu URL INSTANCES MEMORY CORES
+    TIME_STRING MULT_DATA [DATASET] — the reference's argv order
+    (DDM_Process.py:15-21), Spark-only knobs recorded verbatim (C11)."""
+    import csv
+
+    from distributed_drift_detection_tpu.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    main(["spark://x:7077", "4", "8g", "2", "stamp-1", "8",
+          "/root/reference/outdoorStream.csv"])
+    assert "detections=" in capsys.readouterr().out
+    row = list(csv.reader(open(tmp_path / "ddm_cluster_runs.csv")))[-1]
+    assert row[1:7] == ["stamp-1", "spark://x:7077", "4", "8.0", "8g", "2"]
+
+
+def test_argv_entry_point_rejects_partial_args():
+    import pytest
+
+    from distributed_drift_detection_tpu.__main__ import main
+
+    with pytest.raises(SystemExit, match="usage"):
+        main(["only", "three", "args"])
