@@ -66,7 +66,7 @@ def _sea_batch(key, rows, drift_every, features):
     return X, y
 
 
-def _hyperplane_batch(key, rows, drift_every, features):
+def _hyperplane_batch(key, rows, drift_every, features, rotate_period=0):
     kx, _ = jax.random.split(key)
     X = jax.random.uniform(kx, (rows.shape[0], features))
     block = rows // drift_every
@@ -78,9 +78,27 @@ def _hyperplane_batch(key, rows, drift_every, features):
         )
 
     w = jax.vmap(w_for)(block)  # [B, F]
+    if rotate_period:
+        # Gradual drift (io.synth.hyperplane_chunk's rotation, made
+        # f32-exact at 1e9-row scale): a smooth per-row rotation of the
+        # weight vector on top of the abrupt per-concept redraws — the
+        # "abrupt+gradual" soak regime of the BASELINE.json config. The
+        # phase is reduced modulo the integer rotation period *before* the
+        # float cast; a raw f32 global row index quantizes to 64-row steps
+        # near 1e9 and would silently turn the gradual sweep into plateaus.
+        frac = (rows % rotate_period).astype(jnp.float32) / rotate_period
+        phase = (2.0 * jnp.pi) * frac[:, None]
+        w = w + 0.3 * jnp.sin(phase + jnp.arange(features, dtype=jnp.float32))
     margin = jnp.sum(X * w, axis=1) - 0.5 * jnp.sum(w, axis=1)
     y = (margin > 0).astype(jnp.int32)
     return X, y
+
+
+def _hyperplane_gradual_batch(key, rows, drift_every, features):
+    # One full boundary rotation per concept: gradual within, abrupt across.
+    return _hyperplane_batch(
+        key, rows, drift_every, features, rotate_period=max(drift_every, 1)
+    )
 
 
 def _prototype_batch(key, rows, drift_every, features, classes=8, noise=0.08):
@@ -102,6 +120,7 @@ def _prototype_batch(key, rows, drift_every, features, classes=8, noise=0.08):
 _GENERATORS = {
     "sea": (_sea_batch, 3),
     "hyperplane": (_hyperplane_batch, 10),
+    "hyperplane_gradual": (_hyperplane_gradual_batch, 10),
     "prototypes": (_prototype_batch, 8),
 }
 

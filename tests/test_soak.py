@@ -36,7 +36,10 @@ def test_soak_is_deterministic():
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
-@pytest.mark.parametrize("generator,f", [("sea", 3), ("hyperplane", 10)])
+@pytest.mark.parametrize(
+    "generator,f",
+    [("sea", 3), ("hyperplane", 10), ("hyperplane_gradual", 10)],
+)
 def test_other_generators_execute(generator, f):
     """SEA/hyperplane have irreducible in-concept error, under which the
     reference's 3/0.5/1.5 DDM settings fire on noise (documented behaviour)
