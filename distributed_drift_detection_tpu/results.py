@@ -16,10 +16,26 @@ from .metrics import RESULT_COLUMNS
 
 
 def append_result(path: str, row: list) -> None:
-    exists = os.path.exists(path)
+    """Append one run row (writing the header on first use).
+
+    Safe under concurrent writers (several grid processes sharing one
+    results file — the reference's own usage pattern, where every
+    ``run_experiments.sh`` invocation appends to the same CSV): an exclusive
+    ``flock`` spans the header check and the row write, so rows can neither
+    interleave mid-line nor race the header.
+    """
     with open(path, "a", newline="") as fh:
+        try:
+            import fcntl
+
+            fcntl.flock(fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):  # non-POSIX / fs without flock:
+            pass  # best-effort append
+        # Header decision under the lock: another process may have written
+        # it between our open and lock. Position is authoritative.
+        fh.seek(0, os.SEEK_END)
         writer = csv.writer(fh)
-        if not exists:
+        if fh.tell() == 0:
             writer.writerow(RESULT_COLUMNS)
         writer.writerow([_fmt(v) for v in row])
 

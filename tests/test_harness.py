@@ -104,3 +104,42 @@ def test_argv_entry_point_rejects_partial_args():
 
     with pytest.raises(SystemExit, match="usage"):
         main(["only", "three", "args"])
+
+
+def _append_worker(args):
+    path, i = args
+    from distributed_drift_detection_tpu.results import append_result
+
+    append_result(path, [f"app{i}", "t", "u", 1, 1.0, "-", 0,
+                         0.5, 1.0, "d", 100, 1000, 2000.0, i])
+    return i
+
+
+def test_append_result_concurrent_writers(tmp_path):
+    """Concurrent appends from many processes produce a well-formed CSV:
+    exactly one header, every row intact (the reference's multi-invocation
+    append pattern)."""
+    import concurrent.futures as cf
+    import csv as _csv
+
+    from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
+
+    path = str(tmp_path / "concurrent.csv")
+    n = 24
+
+    import multiprocessing as mp
+
+    # spawn, not fork: the test process has a live (multithreaded) JAX.
+    with cf.ProcessPoolExecutor(
+        max_workers=8, mp_context=mp.get_context("spawn")
+    ) as ex:
+        got = sorted(ex.map(_append_worker, [(path, i) for i in range(n)]))
+    assert got == list(range(n))
+
+    with open(path) as fh:
+        rows = list(_csv.reader(fh))
+    assert rows[0] == RESULT_COLUMNS
+    body = rows[1:]
+    assert len(body) == n
+    assert all(len(r) == len(RESULT_COLUMNS) for r in body)
+    assert sorted(int(r[-1]) for r in body) == list(range(n))
