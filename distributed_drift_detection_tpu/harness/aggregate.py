@@ -25,27 +25,30 @@ def load_runs(results_csv: str) -> pd.DataFrame:
         # "<dataset>-<time-string>" (C13). Fragile for hyphenated paths,
         # which is why the native schema carries an explicit Dataset column.
         df["Dataset"] = df["Spark App"].str.split("-").str[0].map(os.path.basename)
-    for col in ("Final Time", "Average Distance", "Data Multiplier"):
-        df[col] = pd.to_numeric(df[col], errors="coerce")
+    for col in ("Final Time", "Average Distance", "Data Multiplier",
+                "Rows", "Rows Per Sec"):
+        if col in df.columns:
+            df[col] = pd.to_numeric(df[col], errors="coerce")
     return df
 
 
 def aggregate(df: pd.DataFrame) -> pd.DataFrame:
     """Per-config mean/variance/count over trials (notebook cell 0)."""
-    g = df.groupby(GROUP_COLS, dropna=False)
-    out = g.agg(
+    spec = dict(
         mean_time=("Final Time", "mean"),
         var_time=("Final Time", "var"),
         mean_delay=("Average Distance", "mean"),
         var_delay=("Average Distance", "var"),
         trials=("Final Time", "count"),
-    ).reset_index()
+    )
     if "Rows Per Sec" in df.columns:
-        out = out.merge(
-            g.agg(mean_rows_per_sec=("Rows Per Sec", "mean")).reset_index(),
-            on=GROUP_COLS,
-        )
-    return out
+        spec["mean_rows_per_sec"] = ("Rows Per Sec", "mean")
+    if "Rows" in df.columns:
+        # Stream length (constant across a config's trials): lets the delay-%
+        # figures normalise by the actual row count instead of the legacy
+        # rows-per-multiplier heuristic.
+        spec["rows"] = ("Rows", "max")
+    return df.groupby(GROUP_COLS, dropna=False).agg(**spec).reset_index()
 
 
 def speedup_table(agg: pd.DataFrame) -> pd.DataFrame:

@@ -76,13 +76,21 @@ def plot_scaleup(agg, out_path: str, coupling: float = 16.0):
 
 
 def plot_delay(agg, out_path: str, stream_rows_per_mult: int = 4000, variance=False):
-    """Delay as % of stream length (cell 9) or its variance (cell 10)."""
+    """Delay as % of stream length (cell 9) or its variance (cell 10).
+
+    Stream length comes from the results' ``Rows`` column when present
+    (native schema); ``stream_rows_per_mult`` is the legacy fallback for
+    reference-style CSVs without it (4000 = outdoorStream rows per
+    multiplier).
+    """
     plt = _plt()
     col = "var_delay" if variance else "mean_delay"
     frame = agg.copy()
-    frame["delay_pct"] = 100.0 * frame[col] / (
-        frame["Data Multiplier"] * stream_rows_per_mult
-    )
+    if "rows" in frame.columns:
+        stream_rows = frame["rows"]
+    else:
+        stream_rows = frame["Data Multiplier"] * stream_rows_per_mult
+    frame["delay_pct"] = 100.0 * frame[col] / stream_rows
     mults = sorted(frame["Data Multiplier"].unique())
     fig, axes = plt.subplots(1, max(len(mults), 1), figsize=(4 * max(len(mults), 1), 3.2))
     axes = [axes] if len(mults) <= 1 else list(axes)
