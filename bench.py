@@ -174,7 +174,27 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--soak":
-        soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
-    else:
-        main()
+    is_soak = len(sys.argv) > 1 and sys.argv[1] == "--soak"
+    try:
+        if is_soak:
+            soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
+        else:
+            main()
+    except Exception as e:  # still emit ONE parseable JSON line on failure
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)  # full diagnostic to stderr
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "soak_rows_per_sec_chip" if is_soak else "rows_per_sec_chip"
+                    ),
+                    "value": None,
+                    "unit": "rows/s",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+        )
+        raise SystemExit(1)
