@@ -8,8 +8,16 @@ jit-compiled streaming kernel vmapped over partitions and sharded over a
 ``jax.sharding.Mesh`` instead of a Spark cluster.
 """
 
-from .config import DDMParams, RunConfig, replace
-from .ops import DDMState, ddm_batch, ddm_init, ddm_scan, ddm_step
+from .config import DDMParams, EDDMParams, PHParams, RunConfig, replace
+from .ops import (
+    DDMState,
+    DetectorKernel,
+    ddm_batch,
+    ddm_init,
+    ddm_scan,
+    ddm_step,
+    make_detector,
+)
 
 __version__ = "0.1.0"
 
@@ -24,13 +32,17 @@ def run(cfg, stream=None):
 
 __all__ = [
     "DDMParams",
+    "EDDMParams",
+    "PHParams",
     "RunConfig",
     "replace",
     "DDMState",
+    "DetectorKernel",
     "ddm_batch",
     "ddm_init",
     "ddm_scan",
     "ddm_step",
+    "make_detector",
     "run",
     "__version__",
 ]

@@ -71,6 +71,8 @@ def _cached_runner(
     cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool, model
 ):
     def build():
+        from .ops.detectors import make_detector
+
         mesh = make_mesh(n_dev) if n_dev > 1 else None
         runner = make_mesh_runner(
             model,
@@ -81,6 +83,9 @@ def _cached_runner(
             window=cfg.window,
             indexed=indexed,
             ddm_impl=cfg.ddm_kernel,
+            detector=make_detector(
+                cfg.detector, ddm=cfg.ddm, ph=cfg.ph, eddm=cfg.eddm
+            ),
         )
         return runner, mesh
 
@@ -90,7 +95,7 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.ddm_kernel,
+        cfg.ddm_kernel, cfg.detector, cfg.ph, cfg.eddm,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

@@ -50,6 +50,39 @@ class DDMParams(NamedTuple):
     out_control_level: float = 1.5
 
 
+class PHParams(NamedTuple):
+    """Page–Hinkley hyper-parameters (detector='ph', ops/detectors.py).
+
+    ``delta`` is the magnitude tolerance, ``threshold`` (λ) the detection
+    bar, ``alpha`` the forgetting factor on the cumulative statistic
+    (1.0 = classic unforgetting CUSUM), ``warning_fraction`` the
+    reported-only warning bar as a fraction of λ.
+
+    λ is a *cumulative* excess-error budget: the detector needs roughly λ
+    error elements beyond the running mean before firing, so it must be
+    small relative to the per-partition concept length (λ=50 on 100-element
+    concepts detects late or never — the same sensitivity story as the
+    reference cranking DDM's defaults 30/2/3 down to 3/0.5/1.5,
+    ``DDM_Process.py:27-29``). λ≈10 matches the reference's planted-drift
+    benchmark geometry at 8 partitions.
+    """
+
+    min_num_instances: int = 30
+    delta: float = 0.005
+    threshold: float = 50.0
+    alpha: float = 1.0
+    warning_fraction: float = 0.5
+
+
+class EDDMParams(NamedTuple):
+    """EDDM hyper-parameters (detector='eddm', ops/detectors.py;
+    Baena-García et al. 2006 defaults)."""
+
+    min_num_errors: int = 30
+    warning_alpha: float = 0.95
+    change_beta: float = 0.9
+
+
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
     """Full configuration of one drift-detection run."""
@@ -69,7 +102,14 @@ class RunConfig:
     model: str = "linear"
 
     # --- detector (reference C6) ---
+    # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' —
+    # the detector zoo, ops/detectors.py. Non-DDM detectors are a framework
+    # extension: the reference only ships DDM, so cross-reference parity
+    # claims (delay tables, oracle goldens) hold for detector='ddm'.
+    detector: str = "ddm"
     ddm: DDMParams = DDMParams()
+    ph: PHParams = PHParams()
+    eddm: EDDMParams = EDDMParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

@@ -25,8 +25,13 @@ from jax import lax
 
 from ..config import DDMParams
 from ..models.base import Model
-from ..ops.ddm import ddm_init
-from .loop import Batches, FlagRows, LoopCarry, make_partition_step
+from .loop import (
+    Batches,
+    FlagRows,
+    LoopCarry,
+    make_partition_step,
+    resolve_detector,
+)
 
 
 class ChunkResult(NamedTuple):
@@ -53,6 +58,7 @@ class ChunkedDetector:
         seed: int = 0,
         window: int = 1,
         mesh=None,
+        detector=None,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -69,6 +75,7 @@ class ChunkedDetector:
         # (keys split per window vs per batch).
         self.model = model
         self.partitions = partitions
+        self._detector = resolve_detector(ddm_params, detector)
         if window == 0:
             raise ValueError(
                 "window=0 (auto) needs stream geometry the chunked engine "
@@ -84,6 +91,7 @@ class ChunkedDetector:
                 window=window,
                 shuffle=shuffle,
                 retrain_error_threshold=retrain_error_threshold,
+                detector=self._detector,
             )
             run_chunk = span
         else:
@@ -92,6 +100,7 @@ class ChunkedDetector:
                 ddm_params,
                 shuffle=shuffle,
                 retrain_error_threshold=retrain_error_threshold,
+                detector=self._detector,
             )
 
             def run_chunk(carry: LoopCarry, batches: Batches):
@@ -127,7 +136,9 @@ class ChunkedDetector:
         params = jax.vmap(self.model.init)(init_keys[:, 1])
         return LoopCarry(
             params=params,
-            ddm=jax.vmap(lambda _: ddm_init())(jnp.arange(self.partitions)),
+            ddm=jax.vmap(lambda _: self._detector.init())(
+                jnp.arange(self.partitions)
+            ),
             a_X=first.X[:, 0],
             a_y=first.y[:, 0],
             a_w=first.valid[:, 0].astype(jnp.float32),

@@ -17,7 +17,10 @@ Differences from the reference, all deliberate (SURVEY.md §7):
 * The unseeded ``batch.sample(frac=1)`` shuffles (``:187,190``) become seeded
   ``jax.random.permutation``s (quirk register #nondeterminism).
 * Short/padded rows are masked via a validity plane instead of ragged frames.
-* The per-row detector loop is the vectorised :func:`..ops.ddm_batch`.
+* The per-row detector loop is the vectorised :func:`..ops.ddm_batch` — or
+  any other :class:`..ops.detectors.DetectorKernel` (Page–Hinkley, EDDM)
+  passed as ``detector=``; the carry's ``ddm`` slot then holds that
+  detector's state pytree.
 
 Shapes: a partition's stream is ``Batches(X [NB,B,F], y [NB,B],
 rows [NB,B], valid [NB,B])``; batch 0 seeds ``batch_a``; the scan runs over
@@ -35,7 +38,7 @@ from jax import lax
 
 from ..config import DDMParams
 from ..models.base import Model
-from ..ops.ddm import DDMState, ddm_batch, ddm_init
+from ..ops.ddm import DDMState
 
 
 class Batches(NamedTuple):
@@ -83,7 +86,7 @@ class FlagRows(NamedTuple):
 
 class LoopCarry(NamedTuple):
     params: object
-    ddm: DDMState
+    ddm: DDMState | object  # detector state (DDMState for the default kernel)
     a_X: jax.Array  # [B, F]
     a_y: jax.Array  # [B]
     a_w: jax.Array  # [B] f32 validity weights
@@ -101,14 +104,30 @@ def _gather_row(rows, idx):
     return jnp.where(idx >= 0, rows[safe], jnp.int32(-1))
 
 
+def resolve_detector(ddm_params: DDMParams, detector=None):
+    """The kernel an engine runs: ``detector`` if given, else DDM built from
+    ``ddm_params`` (the reference's only statistic)."""
+    if detector is not None:
+        return detector
+    from ..ops.detectors import make_detector
+
+    return make_detector("ddm", ddm=ddm_params)
+
+
 def make_partition_step(
     model: Model,
     ddm_params: DDMParams,
     *,
     shuffle: bool = True,
     retrain_error_threshold: float | None = None,
+    detector=None,
 ):
-    """Build the scan body: ``(carry, batch) -> (carry, FlagRows)``."""
+    """Build the scan body: ``(carry, batch) -> (carry, FlagRows)``.
+
+    ``detector`` (a :class:`..ops.detectors.DetectorKernel`) swaps the drift
+    statistic; ``None`` keeps the reference's DDM with ``ddm_params``.
+    """
+    det = resolve_detector(ddm_params, detector)
 
     def step(carry: LoopCarry, batch) -> tuple[LoopCarry, FlagRows]:
         b_X, b_y, b_rows, b_valid = batch
@@ -133,7 +152,7 @@ def make_partition_step(
         errs = (preds != b_y).astype(jnp.float32)
 
         # Detect (C6) — vectorised batch kernel, state carried across batches.
-        new_ddm, res = ddm_batch(carry.ddm, errs, b_valid, ddm_params)
+        new_ddm, res = det.batch(carry.ddm, errs, b_valid)
         change = (res.first_change >= 0) & nonempty
 
         # Optional fallback (config.retrain_error_threshold): a saturated
@@ -159,7 +178,7 @@ def make_partition_step(
         # :207-210). Empty (fully padded) batches are inert.
         new_carry = LoopCarry(
             params=params,
-            ddm=_select(rotate, ddm_init(), new_ddm),
+            ddm=_select(rotate, det.init(), new_ddm),
             a_X=_select(rotate, b_X, carry.a_X),
             a_y=_select(rotate, b_y, carry.a_y),
             a_w=_select(rotate, b_w, carry.a_w),
@@ -177,24 +196,27 @@ def make_partition_runner(
     *,
     shuffle: bool = True,
     retrain_error_threshold: float | None = None,
+    detector=None,
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
 
     The returned function is pure and jit/vmap-compatible; ``FlagRows`` leaves
     have shape ``[NB-1]``.
     """
+    det = resolve_detector(ddm_params, detector)
     step = make_partition_step(
         model,
         ddm_params,
         shuffle=shuffle,
         retrain_error_threshold=retrain_error_threshold,
+        detector=det,
     )
 
     def run(batches: Batches, key: jax.Array) -> FlagRows:
         key, k_init = jax.random.split(key)
         carry = LoopCarry(
             params=model.init(k_init),
-            ddm=ddm_init(),
+            ddm=det.init(),
             a_X=batches.X[0],
             a_y=batches.y[0],
             a_w=batches.valid[0].astype(jnp.float32),

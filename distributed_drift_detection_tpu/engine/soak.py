@@ -45,8 +45,7 @@ from jax import lax
 
 from ..config import DDMParams
 from ..models.base import Model
-from ..ops.ddm import ddm_init
-from .loop import FlagRows, LoopCarry, make_partition_step
+from .loop import FlagRows, LoopCarry, make_partition_step, resolve_detector
 
 _SEA_THETAS = (8.0, 9.0, 7.0, 9.5)  # io.synth._SEA_THETAS
 
@@ -136,6 +135,7 @@ def make_soak_runner(
     generator: str = "prototypes",
     features: int | None = None,
     mesh=None,
+    detector=None,
 ):
     """Build ``run(key) -> SoakResult``: the full soak as ONE device program.
 
@@ -163,7 +163,8 @@ def make_soak_runner(
             f"soak of {p * nb * b:,} rows exceeds the int32 global-row-index "
             "range (2^31-1); run multiple soaks instead"
         )
-    step = make_partition_step(model, ddm_params, shuffle=False)
+    det = resolve_detector(ddm_params, detector)
+    step = make_partition_step(model, ddm_params, shuffle=False, detector=det)
 
     def run_partition(part_idx: jax.Array, key: jax.Array) -> FlagRows:
         offset = part_idx.astype(jnp.int32) * (nb * b)
@@ -177,7 +178,7 @@ def make_soak_runner(
         X0, y0, _, v0 = batch_at(jnp.int32(0))
         carry = LoopCarry(
             params=model.init(init_key),
-            ddm=ddm_init(),
+            ddm=det.init(),
             a_X=X0,
             a_y=y0,
             a_w=v0.astype(jnp.float32),

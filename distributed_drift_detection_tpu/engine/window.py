@@ -39,7 +39,7 @@ from jax import lax
 
 from ..config import DDMParams
 from ..models.base import Model
-from ..ops.ddm import DDMState, ddm_init, ddm_window
+from ..ops.ddm import DDMState
 from .loop import (
     Batches,
     FlagRows,
@@ -47,13 +47,14 @@ from .loop import (
     LoopCarry,
     _gather_row,
     _select,
+    resolve_detector,
 )
 
 
 class _WinState(NamedTuple):
     ptr: jax.Array  # i32: next uncommitted batch index in [0, NBF]
     params: object
-    ddm: DDMState
+    ddm: DDMState | object  # detector state (DDMState for the default kernel)
     a_X: jax.Array  # [B, F]
     a_y: jax.Array  # [B]
     a_w: jax.Array  # [B] f32
@@ -70,6 +71,7 @@ def make_window_span(
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
     ddm_impl: str = "xla",
+    detector=None,
 ):
     """Build ``span(carry: LoopCarry, batches) -> (LoopCarry, FlagRows)``.
 
@@ -88,10 +90,24 @@ def make_window_span(
     """
     w = int(window)
     assert w >= 1
+    det = resolve_detector(ddm_params, detector)
     if ddm_impl == "pallas":
-        from ..ops.ddm_pallas import ddm_window_pallas as _ddm_window
+        if det.name != "ddm":
+            raise ValueError(
+                f"ddm_impl='pallas' fuses the DDM statistic only; detector "
+                f"{det.name!r} has no Pallas kernel — use ddm_impl='xla'"
+            )
+        from ..ops.ddm_pallas import ddm_window_pallas
+
+        # The kernel's baked params are the single source of truth — a
+        # caller-supplied detector may carry different DDMParams than the
+        # positional ddm_params argument.
+        _pallas_params = det.params
+        _det_window = lambda s, e, v: ddm_window_pallas(  # noqa: E731
+            s, e, v, _pallas_params
+        )
     elif ddm_impl == "xla":
-        _ddm_window = ddm_window
+        _det_window = det.window
     else:
         raise ValueError(f"unknown ddm_impl {ddm_impl!r}; expected 'xla' or 'pallas'")
 
@@ -195,7 +211,7 @@ def make_window_span(
 
             # Speculative DDM over the flattened window (state flows across
             # batch boundaries — ``DDM_Process.py:202``).
-            new_ddm, res = _ddm_window(st.ddm, errs, sl_valid, ddm_params)
+            new_ddm, res = _det_window(st.ddm, errs, sl_valid)
             change = (res.first_change >= 0) & ne  # [W]
 
             if retrain_error_threshold is not None:
@@ -244,7 +260,7 @@ def make_window_span(
                     _select(st.retrain & any_ne_cov, fitted, st.params),
                     st.params,
                 ),
-                ddm=upd(_select(any_rot, ddm_init(), new_ddm), st.ddm),
+                ddm=upd(_select(any_rot, det.init(), new_ddm), st.ddm),
                 a_X=_select(take_rot, sl_X[rpos], st.a_X),
                 a_y=_select(take_rot, sl_y[rpos], st.a_y),
                 a_w=_select(
@@ -280,12 +296,14 @@ def make_window_runner(
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
     ddm_impl: str = "xla",
+    detector=None,
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
 
     Output contract is identical to ``engine.loop.make_partition_runner``:
     ``FlagRows`` leaves of shape ``[NB - 1]`` (batch 0 seeds ``batch_a``).
     """
+    det = resolve_detector(ddm_params, detector)
     span = make_window_span(
         model,
         ddm_params,
@@ -293,6 +311,7 @@ def make_window_runner(
         shuffle=shuffle,
         retrain_error_threshold=retrain_error_threshold,
         ddm_impl=ddm_impl,
+        detector=det,
     )
 
     def run(batches: Batches | IndexedBatches, key: jax.Array) -> FlagRows:
@@ -305,7 +324,7 @@ def make_window_runner(
             a_X, a_y = batches.X[0], batches.y[0]
         carry = LoopCarry(
             params=model.init(k_init),
-            ddm=ddm_init(),
+            ddm=det.init(),
             a_X=a_X,
             a_y=a_y,
             a_w=batches.valid[0].astype(jnp.float32),

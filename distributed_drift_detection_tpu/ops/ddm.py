@@ -197,6 +197,28 @@ def _first_true(mask: jax.Array, limit: jax.Array | None = None):
     return jnp.where(has, pos, jnp.int32(-1))
 
 
+def summarise_batch(warning: jax.Array, change: jax.Array) -> DDMBatchResult:
+    """Per-element masks → first-warning/first-change summary.
+
+    Implements the early-break protocol shared by every detector
+    (``DDM_Process.py:147-152``): the first change wins, and warnings at
+    positions the reference loop never reached don't count.
+    """
+    b = change.shape[-1]
+    first_change = _first_true(change)
+    limit = jnp.where(first_change >= 0, first_change, jnp.int32(b))
+    first_warning = _first_true(warning, limit)
+    return DDMBatchResult(first_warning, first_change)
+
+
+def summarise_window(
+    warning: jax.Array, change: jax.Array, w: int, b: int
+) -> DDMWindowResult:
+    """Flattened ``[W·B]`` masks → per-batch ``[W]`` summaries."""
+    res = summarise_batch(warning.reshape(w, b), change.reshape(w, b))
+    return DDMWindowResult(res.first_warning, res.first_change)
+
+
 def ddm_batch(
     state: DDMState,
     errs: jax.Array,
@@ -221,14 +243,8 @@ def ddm_batch(
     Returns:
       ``(state_after_full_batch, DDMBatchResult)``.
     """
-    b = errs.shape[0]
     new_state, warning, change = _prefix_masks(state, errs, valid, params)
-
-    first_change = _first_true(change)
-    # Warnings at positions the reference loop never reached don't count.
-    limit = jnp.where(first_change >= 0, first_change, jnp.int32(b))
-    first_warning = _first_true(warning, limit)
-    return new_state, DDMBatchResult(first_warning, first_change)
+    return new_state, summarise_batch(warning, change)
 
 
 def ddm_window(
@@ -260,10 +276,4 @@ def ddm_window(
     end_state, warning, change = _prefix_masks(
         state, errs.reshape(-1), valid.reshape(-1), params
     )
-    change = change.reshape(w, b)
-    warning = warning.reshape(w, b)
-
-    first_change = _first_true(change)  # [W]
-    limit = jnp.where(first_change >= 0, first_change, jnp.int32(b))
-    first_warning = _first_true(warning, limit)
-    return end_state, DDMWindowResult(first_warning, first_change)
+    return end_state, summarise_window(warning, change, w, b)

@@ -1,0 +1,367 @@
+"""Detector zoo: pluggable drift detectors behind one kernel interface.
+
+The reference is a single-detector artifact — its only statistic is
+skmultiflow's ``DDM`` (``DDM_Process.py:133,139``; rebuilt TPU-native in
+``ops.ddm``). A drift-detection *framework* owes its users the standard
+alternatives, so this module adds two classic error-stream detectors and a
+uniform :class:`DetectorKernel` seam the engines consume:
+
+* **Page–Hinkley** (:func:`ph_batch`) — the clamped CUSUM test (Page 1954;
+  the streaming form popularised by Gama et al.'s drift surveys): per error
+  indicator ``x_i`` with running mean ``x̄_i``,
+
+      m_i = max(0, α·m_{i−1} + (x_i − x̄_i − δ)),   m_0 = 0
+
+  change when ``m_i > λ`` (after ``min_num_instances`` elements). Warnings
+  are a framework extension (the classic test has none): reported — like the
+  reference's DDM warning zone, reported-only (``DDM_Process.py:147-148``) —
+  when ``m_i > warning_fraction·λ``.
+
+* **EDDM** (:func:`eddm_batch`) — *Early Drift Detection Method* (Baena-
+  García et al. 2006): tracks the distance (in elements) between consecutive
+  errors. With ``k`` errors seen since reset, distance mean ``μ_k`` and
+  population std ``σ_k``, the statistic is ``m2s_k = μ_k + 2σ_k`` and its
+  running maximum ``m2s_max``. At an error that does **not** raise the
+  maximum and once ``k ≥ min_num_errors``: warning when ``m2s_k/m2s_max <
+  α``, change when ``< β`` (shrinking error distances ⇒ drift).
+
+Both are implemented exactly like ``ops.ddm_batch``: the whole microbatch
+(or flattened speculative window) in O(B) vectorised primitives — prefix
+sums for the running statistics and an ``associative_scan`` for the
+sequential part. For Page–Hinkley the recurrence ``m → max(0, α·m + c)`` is
+closed under composition in the family ``m → max(K, A·m + B)``, so the
+per-element maps compose associatively as ``(A, B, K)`` triples. For EDDM
+the between-error distances telescope through prefix sums over error
+events, and the running maximum is an ordinary ``cummax``.
+
+State-reset protocol matches the engines' DDM contract (``ops.ddm``): the
+*caller* resets on change (the reference discards its detector at
+``DDM_Process.py:209``), elements after a batch's first change are dead, and
+the returned end-state is only meaningful when ``first_change == -1``.
+
+``make_detector`` packages each statistic (params baked in) as a
+:class:`DetectorKernel` — the seam ``engine.loop`` / ``engine.window`` /
+``parallel.mesh`` accept via their ``detector=`` argument and
+``RunConfig(detector=...)`` selects by name.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import DDMParams, EDDMParams, PHParams
+from .ddm import (
+    DDMBatchResult,
+    DDMWindowResult,
+    ddm_batch,
+    ddm_init,
+    ddm_window,
+    summarise_batch,
+    summarise_window,
+)
+
+_INF = jnp.inf
+# Finite stand-in for "no clamp" in the Page–Hinkley associative compose:
+# a true -inf would produce 0·(-inf) = NaN when an element with alpha = 0
+# follows an identity (invalid/padded) element. Finite, it multiplies and
+# maxes exactly like -inf for every reachable magnitude (|A| ≤ 1, |B| tiny).
+_NO_CLAMP = jnp.float32(-1e30)
+
+
+class DetectorKernel(NamedTuple):
+    """A drift detector as the engines consume it (params already bound).
+
+    ``batch`` maps ``(state, errs [B] f32, valid [B] bool)`` to
+    ``(end_state, DDMBatchResult)``; ``window`` is the multi-batch form over
+    ``[W, B]`` planes returning ``[W]`` result leaves (state flowing across
+    batch boundaries, exactly :func:`ops.ddm.ddm_window`'s contract).
+    ``params`` is the statistic's hyper-parameter tuple — the single source
+    of truth (the alternate DDM Pallas implementation reads it from here, so
+    both impls of one kernel always share parameters).
+    """
+
+    name: str
+    init: Callable[[], object]
+    batch: Callable[..., tuple[object, DDMBatchResult]]
+    window: Callable[..., tuple[object, DDMWindowResult]]
+    params: object
+
+
+# --------------------------------------------------------------------------
+# Page–Hinkley
+# --------------------------------------------------------------------------
+
+
+class PHState(NamedTuple):
+    """Carried Page–Hinkley state (scalar leaves; vmap adds axes)."""
+
+    count: jax.Array  # i32: elements absorbed since last reset
+    x_sum: jax.Array  # f32: sum of inputs since last reset
+    m: jax.Array  # f32: clamped cumulative statistic
+
+
+def ph_init() -> PHState:
+    return PHState(jnp.int32(0), jnp.float32(0.0), jnp.float32(0.0))
+
+
+def ph_step(
+    state: PHState, err: jax.Array, params: PHParams = PHParams()
+) -> tuple[PHState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec — see module docstring)."""
+    cnt = state.count + 1
+    xsum = state.x_sum + err
+    mean = xsum / cnt.astype(jnp.float32)
+    m = jnp.maximum(0.0, params.alpha * state.m + (err - mean - params.delta))
+    check = cnt >= params.min_num_instances
+    change = check & (m > params.threshold)
+    warning = check & ~change & (m > params.warning_fraction * params.threshold)
+    return PHState(cnt, xsum, m), (warning, change)
+
+
+def _ph_masks(state: PHState, errs: jax.Array, valid: jax.Array, params: PHParams):
+    """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``."""
+    v = valid.astype(jnp.int32)
+    cnt = state.count + jnp.cumsum(v)
+    xsum = state.x_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
+    mean = xsum / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+    # Per-element map m -> max(0, alpha*m + c); invalid elements are the
+    # identity. The family m -> max(K, A*m + B) (A > 0) is closed under
+    # composition, so prefix-compose the (A, B, K) triples associatively.
+    c = errs - mean - params.delta
+    a_el = jnp.where(valid, jnp.float32(params.alpha), 1.0)
+    b_el = jnp.where(valid, c, 0.0)
+    k_el = jnp.where(valid, jnp.float32(0.0), _NO_CLAMP)
+
+    def compose(first, second):  # apply `first`, then `second`
+        a1, b1, k1 = first
+        a2, b2, k2 = second
+        return (a2 * a1, a2 * b1 + b2, jnp.maximum(k2, a2 * k1 + b2))
+
+    a, b, k = lax.associative_scan(compose, (a_el, b_el, k_el))
+    m = jnp.maximum(k, a * state.m + b)
+
+    check = valid & (cnt >= params.min_num_instances)
+    change = check & (m > params.threshold)
+    warning = check & ~change & (m > params.warning_fraction * params.threshold)
+    end_state = PHState(cnt[-1], xsum[-1], m[-1])
+    return end_state, warning, change
+
+
+def ph_batch(
+    state: PHState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: PHParams = PHParams(),
+) -> tuple[PHState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _ph_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def ph_window(
+    state: PHState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: PHParams = PHParams(),
+) -> tuple[PHState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _ph_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
+# EDDM
+# --------------------------------------------------------------------------
+
+
+class EDDMState(NamedTuple):
+    """Carried EDDM state (scalar leaves; vmap adds axes).
+
+    f32 prefix sums of distances and squared distances are exact below 2^24
+    between resets — far beyond any realistic between-drift span.
+    """
+
+    count: jax.Array  # i32: elements absorbed since last reset
+    num_errors: jax.Array  # i32: errors seen since last reset
+    d_sum: jax.Array  # f32: sum of between-error distances
+    d2_sum: jax.Array  # f32: sum of squared distances
+    last_err_t: jax.Array  # i32: element index of the last error (0 = none)
+    m2s_max: jax.Array  # f32: running max of mean + 2*std
+
+
+def eddm_init() -> EDDMState:
+    f = jnp.float32
+    return EDDMState(
+        count=jnp.int32(0),
+        num_errors=jnp.int32(0),
+        d_sum=f(0.0),
+        d2_sum=f(0.0),
+        last_err_t=jnp.int32(0),
+        m2s_max=f(0.0),
+    )
+
+
+def eddm_step(
+    state: EDDMState, err: jax.Array, params: EDDMParams = EDDMParams()
+) -> tuple[EDDMState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec — see module docstring)."""
+    t = state.count + 1
+    is_err = err >= 0.5
+    k = state.num_errors + is_err.astype(jnp.int32)
+    d = (t - state.last_err_t).astype(jnp.float32)
+    d_sum = state.d_sum + jnp.where(is_err, d, 0.0)
+    d2_sum = state.d2_sum + jnp.where(is_err, d * d, 0.0)
+    k_f = jnp.maximum(k, 1).astype(jnp.float32)
+    mean = d_sum / k_f
+    var = jnp.maximum(0.0, d2_sum / k_f - mean * mean)
+    m2s = mean + 2.0 * jnp.sqrt(var)
+
+    update_max = is_err & (m2s > state.m2s_max)
+    check = is_err & ~update_max & (k >= params.min_num_errors)
+    ratio = m2s / jnp.maximum(state.m2s_max, 1e-30)
+    change = check & (ratio < params.change_beta)
+    warning = check & ~change & (ratio < params.warning_alpha)
+
+    new_state = EDDMState(
+        count=t,
+        num_errors=k,
+        d_sum=d_sum,
+        d2_sum=d2_sum,
+        last_err_t=jnp.where(is_err, t, state.last_err_t),
+        m2s_max=jnp.where(update_max, m2s, state.m2s_max),
+    )
+    return new_state, (warning, change)
+
+
+def _eddm_masks(
+    state: EDDMState, errs: jax.Array, valid: jax.Array, params: EDDMParams
+):
+    """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``."""
+    v = valid.astype(jnp.int32)
+    t = state.count + jnp.cumsum(v)  # i32 [N] element index
+    is_err = valid & (errs >= 0.5)
+    k = state.num_errors + jnp.cumsum(is_err.astype(jnp.int32))
+
+    # Element index of the previous error, strictly before each position:
+    # inclusive cummax of (is_err ? t : -1), shifted right, carry-merged.
+    err_t = jnp.where(is_err, t, jnp.int32(-1))
+    incl = lax.cummax(err_t)
+    excl = jnp.concatenate([jnp.full((1,), -1, jnp.int32), incl[:-1]])
+    prev_t = jnp.where(excl > 0, excl, state.last_err_t)
+
+    d = (t - prev_t).astype(jnp.float32)
+    d_mask = jnp.where(is_err, d, 0.0)
+    d_sum = state.d_sum + jnp.cumsum(d_mask)
+    d2_sum = state.d2_sum + jnp.cumsum(d_mask * d_mask)
+    k_f = jnp.maximum(k, 1).astype(jnp.float32)
+    mean = d_sum / k_f
+    var = jnp.maximum(0.0, d2_sum / k_f - mean * mean)
+    m2s = mean + 2.0 * jnp.sqrt(var)
+
+    # Running max of m2s over error events, merged with the carried max.
+    # The detection at an event uses the max *excluding* that event (an
+    # event that raises the max never also signals — see module docstring).
+    m2s_ev = jnp.where(is_err, m2s, -_INF)
+    ev_cummax = lax.cummax(m2s_ev)
+    incl_max = jnp.maximum(ev_cummax, state.m2s_max)
+    excl_max = jnp.maximum(
+        jnp.concatenate([jnp.full((1,), -_INF), ev_cummax[:-1]]),
+        state.m2s_max,
+    )
+    update_max = is_err & (m2s > excl_max)
+
+    check = is_err & ~update_max & (k >= params.min_num_errors)
+    ratio = m2s / jnp.maximum(excl_max, 1e-30)
+    change = check & (ratio < params.change_beta)
+    warning = check & ~change & (ratio < params.warning_alpha)
+
+    end_state = EDDMState(
+        count=t[-1],
+        num_errors=k[-1],
+        d_sum=d_sum[-1],
+        d2_sum=d2_sum[-1],
+        last_err_t=jnp.where(incl[-1] > 0, incl[-1], state.last_err_t),
+        m2s_max=incl_max[-1],
+    )
+    return end_state, warning, change
+
+
+def eddm_batch(
+    state: EDDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: EDDMParams = EDDMParams(),
+) -> tuple[EDDMState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _eddm_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def eddm_window(
+    state: EDDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: EDDMParams = EDDMParams(),
+) -> tuple[EDDMState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _eddm_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+DETECTOR_NAMES = ("ddm", "ph", "eddm")
+
+
+def make_detector(
+    name: str,
+    *,
+    ddm: DDMParams = DDMParams(),
+    ph: PHParams = PHParams(),
+    eddm: EDDMParams = EDDMParams(),
+) -> DetectorKernel:
+    """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
+    if name == "ddm":
+        return DetectorKernel(
+            "ddm",
+            ddm_init,
+            lambda s, e, v: ddm_batch(s, e, v, ddm),
+            lambda s, e, v: ddm_window(s, e, v, ddm),
+            ddm,
+        )
+    if name == "ph":
+        if not 0.0 <= ph.alpha <= 1.0:
+            raise ValueError(
+                f"PHParams.alpha must be in [0, 1], got {ph.alpha}"
+            )
+        return DetectorKernel(
+            "ph",
+            ph_init,
+            lambda s, e, v: ph_batch(s, e, v, ph),
+            lambda s, e, v: ph_window(s, e, v, ph),
+            ph,
+        )
+    if name == "eddm":
+        return DetectorKernel(
+            "eddm",
+            eddm_init,
+            lambda s, e, v: eddm_batch(s, e, v, eddm),
+            lambda s, e, v: eddm_window(s, e, v, eddm),
+            eddm,
+        )
+    raise ValueError(
+        f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"
+    )

@@ -103,6 +103,7 @@ def make_mesh_runner(
     window: int = 1,
     indexed: bool = False,
     ddm_impl: str = "xla",
+    detector=None,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
@@ -141,6 +142,7 @@ def make_mesh_runner(
             shuffle=shuffle,
             retrain_error_threshold=retrain_error_threshold,
             ddm_impl=ddm_impl,
+            detector=detector,
         )
     else:
         run_one = make_partition_runner(
@@ -148,6 +150,7 @@ def make_mesh_runner(
             ddm_params,
             shuffle=shuffle,
             retrain_error_threshold=retrain_error_threshold,
+            detector=detector,
         )
     if indexed:
         # Row table replicated (None axes), index planes partition-major.
