@@ -95,7 +95,7 @@ class RunConfig:
     # --- loop (reference C7, DDM_Process.py:162-213) ---
     per_batch: int = 100
     shuffle_batches: bool = True  # seeded analog of .sample(frac=1) at :187,190
-    # 'majority' | 'centroid' | 'linear' | 'mlp' | 'rf' ('rf' is the
+    # 'majority' | 'centroid' | 'gnb' | 'linear' | 'mlp' | 'rf' ('rf' is the
     # host-callback reference-parity RandomForest, models/rf.py; like 'mlp'
     # its fit consumes a PRNG key, so rf flags are seed-equivalent but not
     # bit-equal across different `window` values).
@@ -127,7 +127,7 @@ class RunConfig:
     # Speculative window width (engine.window): microbatches processed per
     # sequential step between drift checks. 1 = faithful batch-per-step scan;
     # >1 commits up to the first in-window change and replays the rest —
-    # identical flags for deterministic-fit models (majority/centroid/linear),
+    # identical flags for deterministic-fit models (majority/centroid/gnb/linear),
     # ~window× fewer sequential steps. 16 balances speculation waste
     # (~1 window per drift) vs step size. 0 = auto: size the window to the
     # stream's planted drift spacing (one window per per-partition concept,

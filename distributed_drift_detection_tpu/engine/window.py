@@ -22,7 +22,7 @@ the sequential loop would have (``DDM_Process.py:207-210``). With drift every
 instead of scalar-shaped — the TPU-native way to run an inherently sequential
 detector fast.
 
-Exactness: for deterministic-fit models (majority/centroid/linear) with
+Exactness: for deterministic-fit models (majority/centroid/gnb/linear) with
 host-side shuffling, the committed flags are **bit-identical** to
 ``engine.loop`` (tested in ``tests/test_window.py``). For key-consuming fits
 (MLP) the PRNG stream differs (keys split per window, not per batch), so

@@ -2,6 +2,7 @@ from .base import Model, ModelSpec
 from .classifiers import (
     build_model,
     make_centroid,
+    make_gnb,
     make_linear,
     make_majority,
     make_mlp,
@@ -13,6 +14,7 @@ __all__ = [
     "ModelSpec",
     "build_model",
     "make_centroid",
+    "make_gnb",
     "make_linear",
     "make_majority",
     "make_mlp",

@@ -1,6 +1,6 @@
 """TPU-native classifiers for the train/predict/detect loop.
 
-Three families, all pure pytrees (see ``base.py`` for the contract):
+All families are pure pytrees (see ``base.py`` for the contract):
 
 * ``majority`` — predicts the modal class of the training microbatch. The
   cheapest model and a faithful proxy for what the reference's RandomForest
@@ -8,6 +8,9 @@ Three families, all pure pytrees (see ``base.py`` for the contract):
   batches are single-class: it predicts that class until the concept changes.
   Also the model used for *exact* golden tests of the loop, since it is
   deterministic and shared bit-for-bit with the NumPy oracle.
+* ``centroid`` / ``gnb`` — closed-form fits (nearest class centroid;
+  Gaussian naive Bayes with axis-aligned covariance): a couple of one-hot
+  matmuls each, so the engine's fit-every-step SPMD pattern is nearly free.
 * ``linear`` — multinomial logistic regression (softmax), fitted with K
   full-batch gradient steps. One ``[B,F]×[F,C]`` matmul per step — MXU food.
 * ``mlp`` — MLP with configurable hidden widths (default (128, 64), the
@@ -96,6 +99,89 @@ def make_centroid(spec: ModelSpec) -> Model:
         return jnp.argmax(scores, axis=-1).astype(jnp.int32)
 
     return Model("centroid", init, fit, predict)
+
+
+# --------------------------------------------------------------------------
+# Gaussian naive Bayes (closed form)
+# --------------------------------------------------------------------------
+
+
+class GNBParams(NamedTuple):
+    """Prediction-ready form: everything predict needs beyond its two
+    matmuls is folded in at fit time (σ² is recoverable as ½/half_inv_var)."""
+
+    offset: jax.Array  # [F]: global feature mean the moments are centred on
+    half_inv_var: jax.Array  # [C, F]: ½/σ² (smoothed)
+    mean_inv_var: jax.Array  # [C, F]: μc/σ² (class means centred on offset)
+    bias: jax.Array  # [C]: log prior − ½Σ log σ² − ½Σ μc²/σ² (−inf absent)
+
+
+def make_gnb(spec: ModelSpec, *, var_smoothing: float = 1e-6) -> Model:
+    """Gaussian naive Bayes with a closed-form fit.
+
+    The second closed-form family next to ``centroid`` (C4 replacement
+    territory, ``DDM_Process.py:96-105``): per-class feature means and
+    variances from weighted one-hot matmuls, prediction as one ``[B,F]×[F,C]``
+    matmul pair over the expanded quadratic form — so, like ``centroid``,
+    the engine's unconditional fit-every-step SPMD pattern is nearly free,
+    while axis-aligned class covariance (which nearest-centroid ignores)
+    is modelled. Variances are smoothed by ``var_smoothing ×`` the overall
+    feature-variance ceiling (sklearn's ``GaussianNB`` recipe); classes
+    absent from the training batch score −inf and are never predicted.
+    """
+    f, c = spec.num_features, spec.num_classes
+
+    def init(key):
+        return GNBParams(
+            jnp.zeros(f, jnp.float32),
+            jnp.full((c, f), 0.5, jnp.float32),
+            jnp.zeros((c, f), jnp.float32),
+            jnp.full(c, -jnp.inf, jnp.float32).at[0].set(0.0),
+        )
+
+    def fit(key, X, y, w):
+        onehot = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]  # [B, C]
+        counts = jnp.sum(onehot, axis=0)  # [C]
+        denom = jnp.maximum(counts, 1.0)[:, None]
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+        gmean = jnp.sum(X * w[:, None], axis=0) / wsum  # [F]
+        # Moments on globally-centred features: variance is shift-invariant,
+        # and the naive f32 E[x²]−E[x]² form catastrophically cancels when a
+        # feature's offset dwarfs its spread (raw un-normalized CSV streams).
+        Xc = X - gmean
+        mean_c = (onehot.T @ Xc) / denom  # [C, F]
+        sq_c = (onehot.T @ (Xc * Xc)) / denom
+        var = jnp.maximum(sq_c - mean_c * mean_c, 0.0)
+        # Relative smoothing: proportional to the largest per-feature
+        # variance of the batch (weighted, all classes pooled).
+        gvar = jnp.sum(Xc * Xc * w[:, None], axis=0) / wsum
+        eps = var_smoothing * jnp.maximum(jnp.max(gvar), 1e-12)
+        var = var + eps
+        inv_var = 1.0 / var
+        # log(0) = -inf for absent classes; the finite variance/mean terms
+        # keep the sum -inf, so no further masking is needed.
+        log_prior = jnp.log(counts / wsum)
+        bias = (
+            log_prior
+            - 0.5 * jnp.sum(jnp.log(var), axis=1)
+            - 0.5 * jnp.sum(mean_c * mean_c * inv_var, axis=1)
+        )
+        return GNBParams(gmean, 0.5 * inv_var, mean_c * inv_var, bias)
+
+    def predict(params, X):
+        # −½ Σ_f (x−μ)²/σ² + log prior − ½Σ log σ², expanded into two matmuls
+        # on the centred features (the same cancellation argument as in fit:
+        # the expansion is only f32-safe once the offset is removed); the
+        # x-independent terms are folded into ``bias`` at fit time.
+        Xc = X - params.offset
+        scores = (
+            -(Xc * Xc) @ params.half_inv_var.T
+            + Xc @ params.mean_inv_var.T
+            + params.bias
+        )
+        return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+    return Model("gnb", init, fit, predict)
 
 
 # --------------------------------------------------------------------------
@@ -227,6 +313,8 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
         return make_majority(spec)
     if name == "centroid":
         return make_centroid(spec)
+    if name == "gnb":
+        return make_gnb(spec)
     if name == "linear":
         lr = cfg.learning_rate if cfg is not None else 0.5
         return make_linear(spec, learning_rate=lr, **kw)
@@ -249,5 +337,5 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
             cache_size=max(64, 2 * parts),
         )
     raise ValueError(
-        f"unknown model {name!r}; expected majority|centroid|linear|mlp|rf"
+        f"unknown model {name!r}; expected majority|centroid|gnb|linear|mlp|rf"
     )

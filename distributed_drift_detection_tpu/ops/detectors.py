@@ -124,6 +124,19 @@ def ph_step(
 
 def _ph_masks(state: PHState, errs: jax.Array, valid: jax.Array, params: PHParams):
     """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``."""
+    # The (A, B, K)-triple compose below assumes A ≥ 0 (max doesn't
+    # distribute over multiplication by a negative); enforce at every entry
+    # to the vectorised path, not just the make_detector registry, so
+    # ph_batch/ph_window can never silently diverge from ph_step. Any
+    # concrete alpha (Python, NumPy or JAX scalar) is validated; only a
+    # tracer (params passed as a jit argument, float() unavailable) is
+    # waved through — there the registry/engine path has already checked.
+    try:
+        alpha = float(params.alpha)
+    except TypeError:  # jax ConcretizationTypeError is a TypeError
+        alpha = None
+    if alpha is not None and not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"PHParams.alpha must be in [0, 1], got {alpha}")
     v = valid.astype(jnp.int32)
     cnt = state.count + jnp.cumsum(v)
     xsum = state.x_sum + jnp.cumsum(errs * valid.astype(errs.dtype))

@@ -254,6 +254,13 @@ def test_ph_alpha_zero_with_padding_matches_spec():
 def test_ph_rejects_alpha_out_of_range():
     with pytest.raises(ValueError, match="alpha"):
         make_detector("ph", ph=PHParams(alpha=1.5))
+    # the public kernels enforce the compose precondition directly too
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="alpha"):
+        ph_batch(ph_init(), e, v, PHParams(alpha=-0.5))
+    with pytest.raises(ValueError, match="alpha"):
+        ph_window(ph_init(), e.reshape(2, 4), v.reshape(2, 4), PHParams(alpha=1.5))
 
 
 # --------------------------------------------------------------------------

@@ -18,7 +18,7 @@ def separable_batch(rng, n=100, classes=5, f=8):
     return jnp.asarray(X), jnp.asarray(y)
 
 
-@pytest.mark.parametrize("name", ["majority", "centroid", "linear", "mlp"])
+@pytest.mark.parametrize("name", ["majority", "centroid", "gnb", "linear", "mlp"])
 def test_fit_predict_roundtrip(name):
     rng = np.random.default_rng(0)
     model = build_model(name, SPEC)
@@ -34,7 +34,7 @@ def test_fit_predict_roundtrip(name):
         assert err < 0.05, f"{name} train error {err}"
 
 
-@pytest.mark.parametrize("name", ["centroid", "linear", "mlp"])
+@pytest.mark.parametrize("name", ["centroid", "gnb", "linear", "mlp"])
 def test_generalizes_to_same_distribution(name):
     rng = np.random.default_rng(1)
     protos = rng.normal(size=(5, 8)).astype(np.float32) * 3
@@ -66,8 +66,9 @@ def test_weight_mask_excludes_padding():
     )
 
 
-def test_centroid_absent_class_never_predicted():
-    model = build_model("centroid", SPEC)
+@pytest.mark.parametrize("name", ["centroid", "gnb"])
+def test_absent_class_never_predicted(name):
+    model = build_model(name, SPEC)
     X = jnp.zeros((20, 8))
     y = jnp.full(20, 3, jnp.int32)  # only class 3 present
     params = model.fit(jax.random.key(0), X, y, jnp.ones(20))
@@ -75,3 +76,74 @@ def test_centroid_absent_class_never_predicted():
     Xq = jnp.asarray(rng.normal(size=(100, 8)).astype(np.float32))
     preds = model.predict(params, Xq)
     assert (preds == 3).all()
+
+
+def test_gnb_matches_sklearn_predictions():
+    """The closed-form fit agrees with sklearn's GaussianNB decisions on a
+    well-separated problem (same model family: per-class mean/var + prior)."""
+    sklearn_nb = pytest.importorskip("sklearn.naive_bayes")
+    rng = np.random.default_rng(4)
+    protos = rng.normal(size=(5, 8)).astype(np.float32) * 3
+    y = rng.integers(0, 5, 400).astype(np.int32)
+    scales = 0.1 + rng.random((5, 8)).astype(np.float32)  # anisotropic
+    X = protos[y] + scales[y] * rng.normal(size=(400, 8)).astype(np.float32)
+
+    model = build_model("gnb", SPEC)
+    params = model.fit(jax.random.key(0), jnp.asarray(X), jnp.asarray(y), jnp.ones(400))
+
+    ref = sklearn_nb.GaussianNB().fit(X, y)
+    Xq = protos[y] + scales[y] * rng.normal(size=(400, 8)).astype(np.float32)
+    ours = np.asarray(model.predict(params, jnp.asarray(Xq)))
+    theirs = ref.predict(Xq)
+    # Decision boundaries may disagree on borderline points (different
+    # variance smoothing); bulk agreement is the model-family check.
+    assert (ours == theirs).mean() > 0.98
+
+
+def test_gnb_survives_large_feature_offsets():
+    """Variance must be computed on centred features: with a raw offset of
+    ~1000 and spreads of 0.1 vs 0.3, the naive f32 E[x²]−E[x]² form collapses
+    every variance to the smoothing floor and predictions to chance."""
+    rng = np.random.default_rng(6)
+    n = 500
+    y = rng.integers(0, 2, n).astype(np.int32)
+    sigma = np.where(y[:, None] == 0, 0.1, 0.3).astype(np.float32)
+    X = (1000.0 + sigma * rng.normal(size=(n, 8))).astype(np.float32)
+    spec = ModelSpec(num_features=8, num_classes=2)
+    model = build_model("gnb", spec)
+    params = model.fit(jax.random.key(0), jnp.asarray(X), jnp.asarray(y), jnp.ones(n))
+    # fitted variances must reflect the true 0.01 / 0.09, not the eps floor
+    var = 0.5 / np.asarray(params.half_inv_var)
+    np.testing.assert_allclose(var[0], 0.01, rtol=0.5)
+    np.testing.assert_allclose(var[1], 0.09, rtol=0.5)
+    yq = rng.integers(0, 2, n).astype(np.int32)
+    sq = np.where(yq[:, None] == 0, 0.1, 0.3).astype(np.float32)
+    Xq = (1000.0 + sq * rng.normal(size=(n, 8))).astype(np.float32)
+    err = float((np.asarray(model.predict(params, jnp.asarray(Xq))) != yq).mean())
+    assert err < 0.1
+
+
+def test_gnb_beats_centroid_on_anisotropic_classes():
+    """GNB's axis-aligned variances separate classes that share a centroid
+    distance scale but differ in spread — the case centroid cannot model."""
+    rng = np.random.default_rng(5)
+    # two classes, same mean, very different per-feature spread
+    n = 500
+    y = rng.integers(0, 2, n).astype(np.int32)
+    sigma = np.where(y[:, None] == 0, 0.1, 3.0).astype(np.float32)
+    X = (sigma * rng.normal(size=(n, 8))).astype(np.float32)
+    spec = ModelSpec(num_features=8, num_classes=2)
+    key = jax.random.key(0)
+    w = jnp.ones(n)
+
+    gnb = build_model("gnb", spec)
+    cen = build_model("centroid", spec)
+    pg = gnb.fit(key, jnp.asarray(X), jnp.asarray(y), w)
+    pc = cen.fit(key, jnp.asarray(X), jnp.asarray(y), w)
+    yq = rng.integers(0, 2, n).astype(np.int32)
+    sq = np.where(yq[:, None] == 0, 0.1, 3.0).astype(np.float32)
+    Xq = (sq * rng.normal(size=(n, 8))).astype(np.float32)
+    err_g = float((np.asarray(gnb.predict(pg, jnp.asarray(Xq))) != yq).mean())
+    err_c = float((np.asarray(cen.predict(pc, jnp.asarray(Xq))) != yq).mean())
+    assert err_g < 0.1
+    assert err_g < err_c

@@ -71,7 +71,7 @@ def _flags_to_array(flags):
 
 
 @pytest.mark.parametrize("window", [1, 3, 16, 64])
-@pytest.mark.parametrize("model_name", ["majority", "centroid", "linear"])
+@pytest.mark.parametrize("model_name", ["majority", "centroid", "gnb", "linear"])
 def test_window_runner_matches_sequential(window, model_name):
     """Deterministic-fit models, shuffle=False: every flag row identical for
     any window width (including W=1 and W > drift spacing)."""
