@@ -45,7 +45,13 @@ from jax import lax
 
 from ..config import DDMParams
 from ..models.base import Model
-from .loop import FlagRows, LoopCarry, make_partition_step, resolve_detector
+from .loop import (
+    Batches,
+    FlagRows,
+    LoopCarry,
+    make_partition_step,
+    resolve_detector,
+)
 
 _SEA_THETAS = (8.0, 9.0, 7.0, 9.5)  # io.synth._SEA_THETAS
 
@@ -136,6 +142,8 @@ def make_soak_runner(
     features: int | None = None,
     mesh=None,
     detector=None,
+    window: int = 1,
+    chunk_batches: int = 0,
 ):
     """Build ``run(key) -> SoakResult``: the full soak as ONE device program.
 
@@ -146,6 +154,24 @@ def make_soak_runner(
     engine (batch 0 seeds ``batch_a``). With ``mesh`` the partition axis is
     device-sharded (generation included — each device synthesises only its
     own partitions' rows); without it, jit the returned function yourself.
+
+    ``window > 1`` runs the speculative window engine over device-generated
+    chunks: a ``lax.scan`` over chunks of ``chunk_batches`` batches
+    (default ``2·window``; generated in one vmapped shot, bounding the
+    transient generator buffer), each processed by ``engine.window``'s span —
+    cutting the sequential iteration count from ``NB`` to roughly
+    ``NB/chunk_batches + NB/window + drifts``. Same flags as the sequential
+    scan for deterministic-fit models (the window engine's exactness
+    contract; keys split per window, so 'mlp' is seed-equivalent only).
+
+    When it helps: small per-step workloads (small ``per_batch`` × few
+    partitions), where the scan is iteration-latency-bound — the same regime
+    the one-shot window engine accelerates ~W×. At the BASELINE.json soak
+    geometry (64 partitions × 1000-row batches ≈ 64 k rows *per step*) each
+    sequential step is already chunky and speculation only adds window
+    slicing + drift-replay overhead: measured on one TPU chip at 1e8 rows,
+    ``window=64`` runs ~0.6× the sequential engine's throughput. The
+    benchmark therefore keeps ``window=1`` for the soak.
     """
     try:
         gen, default_f = _GENERATORS[generator]
@@ -164,7 +190,35 @@ def make_soak_runner(
             "range (2^31-1); run multiple soaks instead"
         )
     det = resolve_detector(ddm_params, detector)
-    step = make_partition_step(model, ddm_params, shuffle=False, detector=det)
+    if window < 1:
+        # window=0 means "auto" framework-wide (config.auto_window); the
+        # soak could resolve it from drift_every but a caller wiring
+        # RunConfig.window straight through should get the same loud
+        # behaviour as engine.chunked, not a silent sequential fallback.
+        raise ValueError(
+            "window must be >= 1 for the soak engine (0 = auto is resolved "
+            "by config.auto_window; pass an explicit width here)"
+        )
+    if chunk_batches < 0:
+        raise ValueError(
+            f"chunk_batches must be >= 0 (0 = auto), got {chunk_batches}"
+        )
+    if chunk_batches and window <= 1:
+        raise ValueError(
+            "chunk_batches only applies to the windowed soak (window > 1); "
+            "the sequential scan does not chunk"
+        )
+    if window > 1:
+        from .window import make_window_span
+
+        span = make_window_span(
+            model, ddm_params, window=window, shuffle=False, detector=det
+        )
+        cb = int(chunk_batches) or 2 * int(window)
+    else:
+        step = make_partition_step(
+            model, ddm_params, shuffle=False, detector=det
+        )
 
     def run_partition(part_idx: jax.Array, key: jax.Array) -> FlagRows:
         offset = part_idx.astype(jnp.int32) * (nb * b)
@@ -186,11 +240,42 @@ def make_soak_runner(
             key=key,
         )
 
-        def scan_step(c, t):
-            return step(c, batch_at(t))
+        if window <= 1:
+            def scan_step(c, t):
+                return step(c, batch_at(t))
 
-        _, flags = lax.scan(scan_step, carry, jnp.arange(1, nb, dtype=jnp.int32))
-        return flags
+            _, flags = lax.scan(
+                scan_step, carry, jnp.arange(1, nb, dtype=jnp.int32)
+            )
+            return flags
+
+        # Window mode: generate CB batches per chunk in one vmapped shot and
+        # run the speculative span over them; the carry crosses chunks
+        # exactly as in engine.chunked. Batches past nb-1 (the last chunk's
+        # tail) are invalid — inert in the span, flag rows stay −1.
+        nbf = nb - 1
+        num_chunks = -(-nbf // cb)
+
+        def gen_chunk(ci):
+            ts = 1 + ci * cb + jnp.arange(cb, dtype=jnp.int32)
+            in_range = ts < nb
+            X, y, rows, _ = jax.vmap(
+                lambda t: batch_at(jnp.minimum(t, nb - 1))
+            )(ts)
+            valid = jnp.broadcast_to(in_range[:, None], (cb, b))
+            rows = jnp.where(valid, rows, -1)
+            return Batches(X, y, rows, valid)
+
+        def chunk_body(c, ci):
+            return span(c, gen_chunk(ci))
+
+        _, flags = lax.scan(
+            chunk_body, carry, jnp.arange(num_chunks, dtype=jnp.int32)
+        )
+        # [NC, CB] chunk-major flag rows → flat [NBF]
+        return jax.tree.map(
+            lambda x: x.reshape(num_chunks * cb, *x.shape[2:])[:nbf], flags
+        )
 
     if mesh is not None:
         from ..models.base import require_shardable

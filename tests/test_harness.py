@@ -125,13 +125,16 @@ def test_append_result_concurrent_writers(tmp_path):
     from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
 
     path = str(tmp_path / "concurrent.csv")
-    n = 24
+    # Every spawned worker pays a full package import (~1s); 10 writers over
+    # 5 workers exercise the same lock contention as more at half the wall
+    # time.
+    n = 10
 
     import multiprocessing as mp
 
     # spawn, not fork: the test process has a live (multithreaded) JAX.
     with cf.ProcessPoolExecutor(
-        max_workers=8, mp_context=mp.get_context("spawn")
+        max_workers=5, mp_context=mp.get_context("spawn")
     ) as ex:
         got = sorted(ex.map(_append_worker, [(path, i) for i in range(n)]))
     assert got == list(range(n))

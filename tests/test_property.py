@@ -21,10 +21,23 @@ from oracle import oracle_run_ddm
 B = 24  # fixed batch length → one jit compile per test
 
 
+# Module-level jitted kernels with params as a *traced argument*: one compile
+# serves every hypothesis example. (A fresh `jax.jit(lambda ...)` per example
+# — or params captured by closure — retraces per draw and used to dominate
+# the suite's runtime at ~1 s/example.)
+@jax.jit
+def _jit_batch(state, errs, params):
+    return ddm_batch(state, errs, jnp.ones(B, bool), params)
+
+
+@jax.jit
+def _jit_window(state, errs, valid, params):
+    return ddm_window(state, errs, valid, params)
+
+
 def run_kernel(params: DDMParams, errs: np.ndarray):
     """One fresh-state batch through the jitted kernel."""
-    jit_batch = jax.jit(lambda s, e: ddm_batch(s, e, jnp.ones(B, bool), params))
-    return jit_batch(ddm_init(), jnp.asarray(errs))
+    return _jit_batch(ddm_init(), jnp.asarray(errs), params)
 
 
 @settings(max_examples=30, deadline=None)
@@ -80,14 +93,13 @@ def test_ddm_window_matches_chained_batches(data):
     ).reshape(w, B)
     valid = np.ones((w, B), bool)
 
-    end_w, res_w = jax.jit(lambda s, e, v: ddm_window(s, e, v, params))(
-        ddm_init(), jnp.asarray(errs), jnp.asarray(valid)
+    end_w, res_w = _jit_window(
+        ddm_init(), jnp.asarray(errs), jnp.asarray(valid), params
     )
     st_ = ddm_init()
-    jit_b = jax.jit(lambda s, e: ddm_batch(s, e, jnp.ones(B, bool), params))
     stop = w
     for k in range(w):
-        st_, rb = jit_b(st_, jnp.asarray(errs[k]))
+        st_, rb = _jit_batch(st_, jnp.asarray(errs[k]), params)
         if k <= stop:
             assert int(res_w.first_change[k]) == int(rb.first_change), k
             assert int(res_w.first_warning[k]) == int(rb.first_warning), k

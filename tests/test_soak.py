@@ -57,6 +57,22 @@ def test_unknown_generator_rejected():
         )
 
 
+@pytest.mark.parametrize("window,chunk_batches", [(8, 11), (16, 0)])
+def test_window_soak_matches_sequential(window, chunk_batches):
+    """The windowed soak (speculative span over device-generated chunks) is
+    bit-identical to the batch-per-step scan, including ragged last chunks
+    (39 flag batches: chunk_batches=11 leaves a 6-batch tail, auto cb=32
+    leaves a 7-batch tail — both exercise the invalid-tail masking)."""
+    seq = _run(num_batches=40, drift_every=1500)
+    win = _run(
+        num_batches=40, drift_every=1500,
+        window=window, chunk_batches=chunk_batches,
+    )
+    for name, a, b in zip(seq.flags._fields, seq.flags, win.flags):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    assert win.rows_processed == seq.rows_processed
+
+
 def test_soak_mesh_sharded_matches_single_device():
     from distributed_drift_detection_tpu.parallel.mesh import make_mesh
 
