@@ -83,6 +83,12 @@ class EDDMParams(NamedTuple):
     change_beta: float = 0.9
 
 
+# Valid RunConfig.detector values (kernels in ops/detectors.py). Lives here,
+# not in ops/, so jax-free consumers (the grid harness CLI) can validate
+# without initialising a backend.
+DETECTOR_NAMES = ("ddm", "ph", "eddm")
+
+
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
     """Full configuration of one drift-detection run."""

@@ -15,7 +15,13 @@ import os
 import numpy as np
 import pandas as pd
 
-GROUP_COLS = ["Dataset", "Instances", "Data Multiplier", "Memory", "Cores"]
+GROUP_COLS = [
+    "Dataset", "Instances", "Data Multiplier", "Memory", "Cores",
+    "Model", "Detector",
+]
+# Per-config identity *below* the instances axis: the tables/figures pivot
+# Instances out of these.
+CONFIG_COLS = ["Dataset", "Data Multiplier", "Cores", "Model", "Detector"]
 
 
 def load_runs(results_csv: str) -> pd.DataFrame:
@@ -25,6 +31,11 @@ def load_runs(results_csv: str) -> pd.DataFrame:
         # "<dataset>-<time-string>" (C13). Fragile for hyphenated paths,
         # which is why the native schema carries an explicit Dataset column.
         df["Dataset"] = df["Spark App"].str.split("-").str[0].map(os.path.basename)
+    for col in ("Model", "Detector"):
+        # Rows written before the model/detector sweep columns existed: mark
+        # unknown rather than conflating with any swept value.
+        if col not in df.columns:
+            df[col] = "-"
     for col in ("Final Time", "Average Distance", "Data Multiplier",
                 "Rows", "Rows Per Sec"):
         if col in df.columns:
@@ -52,22 +63,21 @@ def aggregate(df: pd.DataFrame) -> pd.DataFrame:
 
 
 def speedup_table(agg: pd.DataFrame) -> pd.DataFrame:
-    """T(min instances) / T(n) per (Dataset, Multiplier, Cores) — cell 5."""
+    """T(min instances) / T(n) per config (cell 5)."""
     rows = []
-    for (ds, mult, cores), grp in agg.groupby(["Dataset", "Data Multiplier", "Cores"]):
+    for key, grp in agg.groupby(CONFIG_COLS, dropna=False):
         grp = grp.sort_values("Instances")
         base = grp["mean_time"].iloc[0]
         for _, r in grp.iterrows():
-            rows.append(
+            row = dict(zip(CONFIG_COLS, key))
+            row.update(
                 {
-                    "Dataset": ds,
-                    "Data Multiplier": mult,
-                    "Cores": cores,
                     "Instances": r["Instances"],
                     "mean_time": r["mean_time"],
                     "speedup": base / r["mean_time"] if r["mean_time"] else np.nan,
                 }
             )
+            rows.append(row)
     return pd.DataFrame(rows)
 
 
@@ -78,7 +88,9 @@ def scaleup_table(agg: pd.DataFrame, coupling: float = 16.0) -> pd.DataFrame:
     sel = agg[np.isclose(agg["Data Multiplier"], coupling * agg["Instances"])]
     sel = sel.sort_values(["Dataset", "Cores", "Instances"])
     out = sel.copy()
-    base = sel.groupby(["Dataset", "Cores"])["mean_time"].transform("first")
+    base = sel.groupby(
+        ["Dataset", "Cores", "Model", "Detector"], dropna=False
+    )["mean_time"].transform("first")
     out["scaleup"] = base / out["mean_time"]
     return out
 
@@ -97,7 +109,7 @@ def write_tables(results_csv: str, out_dir: str = ".") -> dict[str, str]:
     emit(
         "time_table.csv",
         agg.pivot_table(
-            index=["Dataset", "Data Multiplier", "Cores"],
+            index=CONFIG_COLS,
             columns="Instances",
             values="mean_time",
         ).reset_index(),
@@ -105,7 +117,7 @@ def write_tables(results_csv: str, out_dir: str = ".") -> dict[str, str]:
     emit(
         "drift_delay.csv",
         agg.pivot_table(
-            index=["Dataset", "Data Multiplier", "Cores"],
+            index=CONFIG_COLS,
             columns="Instances",
             values="mean_delay",
         ).reset_index(),
@@ -113,7 +125,7 @@ def write_tables(results_csv: str, out_dir: str = ".") -> dict[str, str]:
     emit(
         "drift_delay_var.csv",
         agg.pivot_table(
-            index=["Dataset", "Data Multiplier", "Cores"],
+            index=CONFIG_COLS,
             columns="Instances",
             values="var_delay",
         ).reset_index(),

@@ -24,7 +24,7 @@ import itertools
 import sys
 from collections import Counter
 
-from ..config import RunConfig, replace
+from ..config import DETECTOR_NAMES, RunConfig, replace
 from ..results import read_results
 
 
@@ -34,12 +34,23 @@ def grid_configs(
     partitions: list[int],
     models: list[str] | None = None,
     trials: int = 5,
+    detectors: list[str] | None = None,
 ) -> list[RunConfig]:
-    """All (mult × partitions × model × trial) configs of the sweep."""
+    """All (mult × partitions × model × detector × trial) configs."""
     models = models or [base.model]
+    detectors = detectors or [base.detector]
     out = []
-    for m, p, mod, t in itertools.product(mults, partitions, models, range(trials)):
-        cfg = replace(base, mult_data=m, partitions=p, model=mod, seed=base.seed + t)
+    for m, p, mod, det, t in itertools.product(
+        mults, partitions, models, detectors, range(trials)
+    ):
+        cfg = replace(
+            base,
+            mult_data=m,
+            partitions=p,
+            model=mod,
+            detector=det,
+            seed=base.seed + t,
+        )
         out.append(replace(cfg, time_string=f"{_config_key(cfg)}-t{t}"))
     return out
 
@@ -48,12 +59,35 @@ def _config_key(cfg: RunConfig) -> str:
     """Trial-identity key for crash recovery: every knob that changes the
     result must appear, else a re-run with a changed knob silently skips
     trials recorded under the old settings."""
-    d = cfg.ddm
-    thr = f"-r{cfg.retrain_error_threshold}" if cfg.retrain_error_threshold else ""
+    if cfg.detector not in DETECTOR_NAMES:
+        raise ValueError(
+            f"unknown detector {cfg.detector!r}; expected one of {DETECTOR_NAMES}"
+        )
+    thr = (
+        f"-r{cfg.retrain_error_threshold}"
+        if cfg.retrain_error_threshold is not None  # 0.0 is an active setting
+        else ""
+    )
+    # Key-consuming fits (mlp, rf) draw PRNG keys per window, so their flags
+    # depend on the window width (config.py's 'seed-equivalent but not
+    # bit-equal' caveat); deterministic fits are window-invariant (tested),
+    # so their historical keys stay stable.
+    win = f"-w{cfg.window}" if cfg.model in ("mlp", "rf") else ""
+    # The detector segment carries the active statistic's name + full
+    # parameter tuple. The default DDM keeps the historical key shape
+    # (``-ddm<min>_<warn>_<out>``) so existing results CSVs still resume;
+    # non-DDM detectors embed only their own params — the DDM tuple is
+    # inert for them and must not invalidate completed trials.
+    if cfg.detector == "ddm":
+        d = cfg.ddm
+        det = f"ddm{d.min_num_instances}_{d.warning_level}_{d.out_control_level}"
+    else:
+        det = cfg.detector + "_".join(
+            str(v) for v in getattr(cfg, cfg.detector)
+        )
     return (
         f"m{cfg.mult_data}-p{cfg.partitions}-{cfg.model}-b{cfg.per_batch}"
-        f"-ddm{d.min_num_instances}_{d.warning_level}_{d.out_control_level}"
-        f"-s{cfg.seed}{thr}"
+        f"-{det}-s{cfg.seed}{thr}"
     )
 
 
@@ -89,11 +123,12 @@ def run_grid(
     models: list[str] | None = None,
     trials: int = 5,
     progress=print,
+    detectors: list[str] | None = None,
 ) -> int:
     """Run all missing trials of the sweep; returns number executed."""
     from ..api import run  # lazy: keeps harness importable without jax init
 
-    configs = grid_configs(base, mults, partitions, models, trials)
+    configs = grid_configs(base, mults, partitions, models, trials, detectors)
     todo = missing_configs(configs)
     progress(f"grid: {len(configs)} trials total, {len(todo)} to run")
     for i, cfg in enumerate(todo):
@@ -112,6 +147,7 @@ def main(argv=None) -> None:
     ap.add_argument("--mults", default="1,2,4")
     ap.add_argument("--partitions", default="1,2,4,8")
     ap.add_argument("--models", default="linear")
+    ap.add_argument("--detectors", default="ddm")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--per-batch", type=int, default=100)
     ap.add_argument("--results-csv", default="ddm_cluster_runs.csv")
@@ -128,6 +164,7 @@ def main(argv=None) -> None:
         partitions=[int(p) for p in args.partitions.split(",")],
         models=args.models.split(","),
         trials=args.trials,
+        detectors=args.detectors.split(","),
     )
 
 

@@ -104,7 +104,13 @@ def plot_delay(agg, out_path: str, stream_rows_per_mult: int = 4000, variance=Fa
 
 
 def render_all(results_csv: str, out_dir: str = "figures") -> dict[str, str]:
-    """Tables + all five figures. Returns {artifact: path}."""
+    """Tables + all five figures. Returns {artifact: path}.
+
+    Each figure assumes one model/detector combination (the reference's
+    figures have exactly one); a CSV holding a model/detector sweep is
+    rendered as one figure set per combination, suffixed
+    ``-<model>-<detector>`` — never mixed into one set of axes.
+    """
     os.makedirs(out_dir, exist_ok=True)
     artifacts = write_tables(results_csv, out_dir)
     try:
@@ -112,18 +118,23 @@ def render_all(results_csv: str, out_dir: str = "figures") -> dict[str, str]:
     except ImportError:
         return artifacts
     agg = aggregate(load_runs(results_csv))
-    for name, fn in [
-        ("speedup.pdf", plot_speedup),
-        ("time.pdf", plot_time),
-        ("scaleup.pdf", plot_scaleup),
-    ]:
-        path = os.path.join(out_dir, name)
-        fn(agg, path)
-        artifacts[name] = path
-    for name, var in [("delay_pct.pdf", False), ("delay_var.pdf", True)]:
-        path = os.path.join(out_dir, name)
-        plot_delay(agg, path, variance=var)
-        artifacts[name] = path
+    combos = agg[["Model", "Detector"]].drop_duplicates()
+    for _, combo in combos.iterrows():
+        model, det = combo["Model"], combo["Detector"]
+        sub = agg[(agg["Model"] == model) & (agg["Detector"] == det)]
+        suffix = "" if len(combos) == 1 else f"-{model}-{det}"
+        for stem, fn in [
+            ("speedup", plot_speedup),
+            ("time", plot_time),
+            ("scaleup", plot_scaleup),
+        ]:
+            path = os.path.join(out_dir, f"{stem}{suffix}.pdf")
+            fn(sub, path)
+            artifacts[f"{stem}{suffix}.pdf"] = path
+        for stem, var in [("delay_pct", False), ("delay_var", True)]:
+            path = os.path.join(out_dir, f"{stem}{suffix}.pdf")
+            plot_delay(sub, path, variance=var)
+            artifacts[f"{stem}{suffix}.pdf"] = path
     return artifacts
 
 

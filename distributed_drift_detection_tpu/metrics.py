@@ -62,6 +62,8 @@ RESULT_COLUMNS = [
     "Rows",
     "Rows Per Sec",
     "Detections",
+    "Model",
+    "Detector",
 ]
 
 
@@ -85,4 +87,6 @@ def result_row(
         num_rows,
         num_rows / total_time if total_time > 0 else float("nan"),
         metrics.num_detections,
+        cfg.model,
+        cfg.detector,
     ]

@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..config import DDMParams, EDDMParams, PHParams
+from ..config import DDMParams, DETECTOR_NAMES, EDDMParams, PHParams
 from .ddm import (
     DDMBatchResult,
     DDMWindowResult,
@@ -335,9 +335,6 @@ def eddm_window(
 # --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
-
-DETECTOR_NAMES = ("ddm", "ph", "eddm")
-
 
 def make_detector(
     name: str,

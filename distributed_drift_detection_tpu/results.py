@@ -24,7 +24,7 @@ def append_result(path: str, row: list) -> None:
     ``flock`` spans the header check and the row write, so rows can neither
     interleave mid-line nor race the header.
     """
-    with open(path, "a", newline="") as fh:
+    with open(path, "a+", newline="") as fh:
         try:
             import fcntl
 
@@ -37,6 +37,32 @@ def append_result(path: str, row: list) -> None:
         writer = csv.writer(fh)
         if fh.tell() == 0:
             writer.writerow(RESULT_COLUMNS)
+        else:
+            # The file may predate newer schema columns (the schema has
+            # grown over time — Dataset…Detections, then Model/Detector).
+            # Rows must match the header already in the file, or every
+            # CSV consumer downstream chokes on ragged lines; project the
+            # row onto the existing header, dropping columns it lacks.
+            fh.seek(0)
+            existing = next(csv.reader(fh), None)
+            fh.seek(0, os.SEEK_END)
+            if existing and existing != RESULT_COLUMNS:
+                by_name = dict(zip(RESULT_COLUMNS, row))
+                dropped = [c for c in RESULT_COLUMNS if c not in existing]
+                if dropped:
+                    import warnings
+
+                    # Loud, not silent: projecting away e.g. the Detector
+                    # column makes the aggregation layer pool rows that a
+                    # fresh-schema CSV would keep apart.
+                    warnings.warn(
+                        f"results CSV {path!r} predates column(s) "
+                        f"{dropped}; dropping "
+                        f"{ {c: by_name[c] for c in dropped} } from this row "
+                        "— start a fresh CSV to keep them",
+                        stacklevel=2,
+                    )
+                row = [by_name.get(col, "-") for col in existing]
         writer.writerow([_fmt(v) for v in row])
 
 

@@ -50,6 +50,57 @@ def test_grid_idempotent_resume(tmp_path):
     assert n3 == 1
 
 
+def test_append_projects_rows_onto_legacy_header(tmp_path):
+    """Appending to a results CSV written under an older (shorter) schema
+    must project rows onto the file's own header — never ragged lines."""
+    import csv as _csv
+
+    from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
+    from distributed_drift_detection_tpu.results import append_result
+
+    path = str(tmp_path / "legacy.csv")
+    legacy_cols = RESULT_COLUMNS[:-2]  # pre-Model/Detector schema
+    with open(path, "w", newline="") as fh:
+        w = _csv.writer(fh)
+        w.writerow(legacy_cols)
+        w.writerow(["old", "t", "u", 1, 1.0, "-", 0, 0.5, 1.0, "d",
+                    100, 1000, 2000.0, 3])
+    append_result(path, ["new", "t", "u", 2, 2.0, "-", 0, 0.7, 2.0, "d",
+                         100, 2000, 3000.0, 5, "centroid", "ph"])
+    with open(path, newline="") as fh:
+        rows = list(_csv.reader(fh))
+    assert rows[0] == legacy_cols
+    assert all(len(r) == len(legacy_cols) for r in rows[1:])
+    # aggregation still loads it (legacy backfill marks Model/Detector "-")
+    df = load_runs(path)
+    assert set(df["Model"]) == {"-"}
+    assert len(aggregate(df)) == 2
+
+
+def test_grid_detector_sweep_distinct_keys(tmp_path):
+    """Sweeping detectors runs one trial set per detector, with distinct
+    trial-identity keys so resume never conflates them (and DDM keeps the
+    historical key shape for existing results CSVs)."""
+    base = base_cfg(tmp_path)
+    cfgs = grid_configs(base, [1], [1], trials=1, detectors=["ddm", "ph", "eddm"])
+    assert [c.detector for c in cfgs] == ["ddm", "ph", "eddm"]
+    keys = [c.resolved_app_name() for c in cfgs]
+    assert len(set(keys)) == 3
+    assert "ph" in keys[1] and "eddm" in keys[2]
+    assert "ph" not in keys[0] and "eddm" not in keys[0]
+
+    n1 = run_grid(base, mults=[1], partitions=[1], trials=1,
+                  detectors=["ddm", "eddm"], progress=lambda *_: None)
+    assert n1 == 2
+    # resume: nothing left for the swept pair; a new detector still runs
+    n2 = run_grid(base, mults=[1], partitions=[1], trials=1,
+                  detectors=["ddm", "eddm"], progress=lambda *_: None)
+    assert n2 == 0
+    n3 = run_grid(base, mults=[1], partitions=[1], trials=1,
+                  detectors=["ddm", "eddm", "ph"], progress=lambda *_: None)
+    assert n3 == 1
+
+
 def test_aggregate_and_tables(tmp_path):
     base = base_cfg(tmp_path)
     run_grid(base, mults=[1, 2], partitions=[1, 2], trials=2, progress=lambda *_: None)
@@ -111,7 +162,8 @@ def _append_worker(args):
     from distributed_drift_detection_tpu.results import append_result
 
     append_result(path, [f"app{i}", "t", "u", 1, 1.0, "-", 0,
-                         0.5, 1.0, "d", 100, 1000, 2000.0, i])
+                         0.5, 1.0, "d", 100, 1000, 2000.0, i,
+                         "centroid", "ddm"])
     return i
 
 
@@ -145,4 +197,5 @@ def test_append_result_concurrent_writers(tmp_path):
     body = rows[1:]
     assert len(body) == n
     assert all(len(r) == len(RESULT_COLUMNS) for r in body)
-    assert sorted(int(r[-1]) for r in body) == list(range(n))
+    det_col = RESULT_COLUMNS.index("Detections")
+    assert sorted(int(r[det_col]) for r in body) == list(range(n))
