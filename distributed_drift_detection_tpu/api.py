@@ -82,7 +82,6 @@ def _cached_runner(
             retrain_error_threshold=cfg.retrain_error_threshold,
             window=cfg.window,
             indexed=indexed,
-            ddm_impl=cfg.ddm_kernel,
             detector=make_detector(
                 cfg.detector, ddm=cfg.ddm, ph=cfg.ph, eddm=cfg.eddm
             ),
@@ -95,7 +94,7 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.ddm_kernel, cfg.detector, cfg.ph, cfg.eddm,
+        cfg.detector, cfg.ph, cfg.eddm,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

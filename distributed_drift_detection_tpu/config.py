@@ -104,8 +104,11 @@ class RunConfig:
     # 'majority' | 'centroid' | 'gnb' | 'linear' | 'mlp' | 'rf' ('rf' is the
     # host-callback reference-parity RandomForest, models/rf.py; like 'mlp'
     # its fit consumes a PRNG key, so rf flags are seed-equivalent but not
-    # bit-equal across different `window` values).
-    model: str = "linear"
+    # bit-equal across different `window` values). 'centroid' is the
+    # documented flagship (PARITY.md: closed-form fit, rf-grade delay) and
+    # what bench.py measures; 'linear' over-fires ~15× on rialto-like
+    # regimes, so it is deliberately not the default.
+    model: str = "centroid"
 
     # --- detector (reference C6) ---
     # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' —
@@ -142,11 +145,9 @@ class RunConfig:
     # are seed-equivalent but not bit-equal across different window values —
     # pin window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
     window: int = 16
-    # DDM window-statistic implementation: 'xla' (cumsum + associative_scan)
-    # or 'pallas' (ops/ddm_pallas.py — the whole statistic fused into one
-    # VMEM-resident TPU kernel, partitions on the sublane axis; bit-identical
-    # flags, interpreter fallback on CPU). Requires window > 1.
-    ddm_kernel: str = "xla"
+    # (A `ddm_kernel='pallas'` knob existed through round 1; the kernel lost
+    # to the XLA lowering on every measured shape and was removed — see
+    # PARITY.md "Pallas post-mortem".)
 
     # --- model hyper-parameters (TPU-native replacements for RandomForest) ---
     fit_steps: int = 32

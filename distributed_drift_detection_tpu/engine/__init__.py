@@ -7,19 +7,30 @@ from .loop import (
     make_partition_runner,
     make_partition_step,
 )
-from .soak import SoakResult, make_soak_runner
+from .soak import (
+    ChainedSoakSummary,
+    SoakChainState,
+    SoakResult,
+    make_soak_chain,
+    make_soak_runner,
+    run_soak_chained,
+)
 from .window import make_window_runner, make_window_span
 
 __all__ = [
     "Batches",
+    "ChainedSoakSummary",
     "ChunkedDetector",
     "FlagRows",
     "IndexedBatches",
     "LoopCarry",
     "make_partition_runner",
     "make_partition_step",
+    "make_soak_chain",
     "make_soak_runner",
     "make_window_runner",
     "make_window_span",
+    "run_soak_chained",
+    "SoakChainState",
     "SoakResult",
 ]

@@ -61,20 +61,20 @@ class SoakResult(NamedTuple):
     rows_processed: int  # static: P * NB * B
 
 
-def _sea_batch(key, rows, drift_every, features):
+def _sea_batch(key, rows, drift_every, features, block0=0):
     u = jax.random.uniform(key, (rows.shape[0], 3))
     X = u * 10.0
     theta = jnp.asarray(_SEA_THETAS, jnp.float32)[
-        (rows // drift_every) % len(_SEA_THETAS)
+        (block0 + rows // drift_every) % len(_SEA_THETAS)
     ]
     y = (X[:, 0] + X[:, 1] <= theta).astype(jnp.int32)
     return X, y
 
 
-def _hyperplane_batch(key, rows, drift_every, features, rotate_period=0):
+def _hyperplane_batch(key, rows, drift_every, features, rotate_period=0, block0=0):
     kx, _ = jax.random.split(key)
     X = jax.random.uniform(kx, (rows.shape[0], features))
-    block = rows // drift_every
+    block = block0 + rows // drift_every
     # Per-concept weights, deterministic in the block id (same for every
     # batch of the concept): one uniform per (block, feature).
     def w_for(b):
@@ -99,16 +99,22 @@ def _hyperplane_batch(key, rows, drift_every, features, rotate_period=0):
     return X, y
 
 
-def _hyperplane_gradual_batch(key, rows, drift_every, features):
+def _hyperplane_gradual_batch(key, rows, drift_every, features, block0=0):
     # One full boundary rotation per concept: gradual within, abrupt across.
+    # The rotation phase uses `rows % rotate_period` directly, so chained
+    # legs stay phase-continuous as long as leg boundaries are aligned to
+    # drift_every (make_soak_chain enforces this).
     return _hyperplane_batch(
-        key, rows, drift_every, features, rotate_period=max(drift_every, 1)
+        key, rows, drift_every, features,
+        rotate_period=max(drift_every, 1), block0=block0,
     )
 
 
-def _prototype_batch(key, rows, drift_every, features, classes=8, noise=0.08):
+def _prototype_batch(
+    key, rows, drift_every, features, classes=8, noise=0.08, block0=0
+):
     kc, kn = jax.random.split(key)
-    block = rows // drift_every
+    block = block0 + rows // drift_every
     # Per-concept class prototypes, deterministic in the block id.
     def protos_for(b):
         return jax.random.normal(
@@ -183,11 +189,12 @@ def make_soak_runner(
     b, nb, p = int(per_batch), int(num_batches), int(partitions)
     if p * nb * b > 2**31 - 1:
         # Global row positions are int32 framework-wide (FlagRows globals);
-        # beyond 2^31 rows the indices would silently wrap. Split larger
-        # soaks across multiple runs (fresh key each) instead.
+        # beyond 2^31 rows the indices would silently wrap. The chained soak
+        # carries (params, detector state, batch_a, key) across legs with
+        # exact single-stream semantics and partition-local positions.
         raise ValueError(
             f"soak of {p * nb * b:,} rows exceeds the int32 global-row-index "
-            "range (2^31-1); run multiple soaks instead"
+            "range (2^31-1); use run_soak_chained / make_soak_chain"
         )
     det = resolve_detector(ddm_params, detector)
     if window < 1:
@@ -304,3 +311,311 @@ def make_soak_runner(
             ),
         )
     return run
+
+
+# --------------------------------------------------------------------------
+# Chained soak: beyond the int32 row-index ceiling with exact semantics
+# --------------------------------------------------------------------------
+
+
+class SoakChainState(NamedTuple):
+    """Cross-leg carry of the chained soak.
+
+    ``carry`` is the vmapped :class:`LoopCarry` ([P] leading axes) — model
+    params, detector state, ``batch_a`` and the engine's PRNG key flow
+    across legs exactly as they flow across batches inside one leg, so a
+    chained soak is semantically ONE long stream, not S independent ones.
+    ``gen_keys`` [P] are the per-partition *generator* keys, kept separate
+    from the loop key because the engine step advances ``carry.key`` every
+    batch (``engine.loop:134``) while the generator must stay replayable
+    from the absolute batch index.
+    """
+
+    carry: LoopCarry
+    gen_keys: jax.Array  # [P]
+
+
+class SoakLegFlags(NamedTuple):
+    state: SoakChainState
+    flags: FlagRows  # [P, L] (leg 0: [P, L-1] — batch 0 seeds batch_a)
+
+
+def _make_soak_chain_impl(
+    model: Model,
+    ddm_params: DDMParams = DDMParams(),
+    *,
+    partitions: int,
+    per_batch: int,
+    batches_per_leg: int,
+    legs: int,
+    drift_every: int,
+    generator: str = "prototypes",
+    features: int | None = None,
+    detector=None,
+):
+    """Build the state-carrying chained soak (impl form — use
+    :func:`make_soak_chain` for the bound ``(first_leg, next_leg)`` pair).
+
+    Lifts the one-shot runner's int32 global-row ceiling (``p·nb·b ≤ 2³¹−1``)
+    by splitting the stream into ``legs`` device programs of
+    ``batches_per_leg`` batches each, with the full detection state —
+    ``(model params, detector state, batch_a, loop key)`` — carried across
+    legs host-side. Row indices inside a leg are **partition-local** stream
+    positions (``< legs·batches_per_leg·per_batch``, which must fit int32 —
+    at 64 partitions that is a ~1.4e11-row total ceiling); the generator
+    receives the cross-partition concept offset separately as a block id
+    (``block0``), so concept identities and boundaries are exactly those of
+    the equivalent unchained stream.
+
+    Exactness contract (tested in ``tests/test_soak.py``): with the same
+    total geometry and leg boundaries aligned to ``drift_every`` (enforced:
+    ``batches_per_leg·per_batch % drift_every == 0`` — also what keeps
+    ``position % drift_every`` delay arithmetic and the gradual-rotation
+    phase leg-invariant), the concatenated chained flag rows equal the
+    one-shot runner's bit-for-bit, modulo the partition row offset
+    (one-shot rows are global, chain rows partition-local; both key the
+    generator by absolute batch index, ``fold_in(gen_key, s·L + t)``).
+
+    * ``first_leg(key) -> SoakLegFlags`` — seeds ``batch_a`` from batch 0,
+      returns flags ``[P, L-1]``.
+    * ``next_leg(state, leg_idx) -> SoakLegFlags`` — processes all L batches
+      of leg ``leg_idx`` (traced scalar: one executable serves every leg),
+      returns flags ``[P, L]``.
+
+    Sequential engine only (``window=1``): at soak geometry each sequential
+    step is already chunky and speculation loses (see
+    :func:`make_soak_runner`'s window note). ``jax.jit`` both returns.
+    """
+    try:
+        gen, default_f = _GENERATORS[generator]
+    except KeyError:
+        raise ValueError(
+            f"unknown generator {generator!r}; expected one of {sorted(_GENERATORS)}"
+        ) from None
+    f = features or default_f
+    b, L, p, S = int(per_batch), int(batches_per_leg), int(partitions), int(legs)
+    de = int(drift_every)
+    if L * b % de:
+        raise ValueError(
+            f"leg length {L}·{b} rows must be a multiple of drift_every={de} "
+            "(keeps concept ids, delay arithmetic and rotation phase exact "
+            "across leg boundaries)"
+        )
+    t_pp = S * L * b  # per-partition stream length
+    if t_pp > 2**31 - 1:
+        raise ValueError(
+            f"per-partition stream of {t_pp:,} rows exceeds int32 positions; "
+            "raise `partitions` (the ceiling scales with it)"
+        )
+    det = resolve_detector(ddm_params, detector)
+    step = make_partition_step(model, ddm_params, shuffle=False, detector=det)
+    # Per-partition concept-block offsets. Passed into the jitted legs as a
+    # RUNTIME argument, not baked as a constant: blocks_pp depends on the
+    # leg count S, and baking it would give every S a different executable —
+    # defeating warm-up/AOT and the persistent compile cache.
+    blocks_pp = t_pp // de
+    block0s = jnp.arange(p, dtype=jnp.int32) * blocks_pp
+
+    def batch_at(gen_key, block0, t_glob):
+        # Partition-local position; concept id = block0 + pos // drift_every.
+        pos = t_glob * b + jnp.arange(b, dtype=jnp.int32)
+        X, y = gen(
+            jax.random.fold_in(gen_key, t_glob), pos, de, f, block0=block0
+        )
+        return X, y, pos, jnp.ones(b, bool)
+
+    def first_one(key, block0):
+        gen_key, init_key = jax.random.split(key)
+        X0, y0, _, v0 = batch_at(gen_key, block0, jnp.int32(0))
+        carry = LoopCarry(
+            params=model.init(init_key),
+            ddm=det.init(),
+            a_X=X0,
+            a_y=y0,
+            a_w=v0.astype(jnp.float32),
+            retrain=jnp.bool_(True),
+            key=key,
+        )
+
+        def scan_step(c, t):
+            return step(c, batch_at(gen_key, block0, t))
+
+        carry, flags = lax.scan(
+            scan_step, carry, jnp.arange(1, L, dtype=jnp.int32)
+        )
+        return carry, gen_key, flags
+
+    def next_one(carry, gen_key, block0, leg_idx):
+        t0 = leg_idx.astype(jnp.int32) * L
+
+        def scan_step(c, t):
+            return step(c, batch_at(gen_key, block0, t0 + t))
+
+        carry, flags = lax.scan(
+            scan_step, carry, jnp.arange(L, dtype=jnp.int32)
+        )
+        return carry, flags
+
+    def first_leg_impl(key: jax.Array, block0s: jax.Array) -> SoakLegFlags:
+        keys = jax.random.split(key, p)
+        carry, gen_keys, flags = jax.vmap(first_one)(keys, block0s)
+        return SoakLegFlags(SoakChainState(carry, gen_keys), flags)
+
+    def next_leg_impl(
+        state: SoakChainState, leg_idx: jax.Array, block0s: jax.Array
+    ) -> SoakLegFlags:
+        carry, flags = jax.vmap(next_one, in_axes=(0, 0, 0, None))(
+            state.carry, state.gen_keys, block0s, leg_idx
+        )
+        return SoakLegFlags(SoakChainState(carry, state.gen_keys), flags)
+
+    return _SoakChainImpl(
+        first=jax.jit(first_leg_impl),
+        next=jax.jit(next_leg_impl),
+        block0s=block0s,
+    )
+
+
+class _SoakChainImpl(NamedTuple):
+    """Jitted chain legs with the block-offset vector as a runtime arg
+    (see :func:`make_soak_chain` for why it is not a baked constant)."""
+
+    first: object  # jit: (key, block0s) -> SoakLegFlags
+    next: object  # jit: (state, leg_idx, block0s) -> SoakLegFlags
+    block0s: jax.Array  # [P] i32
+
+
+def make_soak_chain(*args, **kwargs):
+    """Public form of :func:`_make_soak_chain_impl`: ``(first_leg, next_leg)``
+    with the block offsets bound — ``first_leg(key)``,
+    ``next_leg(state, leg_idx)``."""
+    impl = _make_soak_chain_impl(*args, **kwargs)
+
+    def first_leg(key: jax.Array) -> SoakLegFlags:
+        return impl.first(key, impl.block0s)
+
+    def next_leg(state: SoakChainState, leg_idx) -> SoakLegFlags:
+        return impl.next(state, jnp.int32(leg_idx), impl.block0s)
+
+    return first_leg, next_leg
+
+
+def planted_interior_boundaries(
+    partitions: int, rows_per_partition: int, drift_every: int
+) -> int:
+    """Exact count of detectable planted boundaries across the soak.
+
+    Partition ``q`` covers global rows ``[q·R, (q+1)·R)``; a boundary at
+    ``m·drift_every`` is detectable only strictly inside that half-open
+    range (a boundary landing exactly on a partition start *begins* its
+    stream — there is no preceding concept to drift from).
+    """
+    r, de = int(rows_per_partition), int(drift_every)
+    return sum(
+        ((q + 1) * r - 1) // de - (q * r) // de for q in range(int(partitions))
+    )
+
+
+class ChainedSoakSummary(NamedTuple):
+    rows_processed: int  # p · legs · batches_per_leg · per_batch
+    legs: int
+    detections: int
+    delays: "object"  # np.ndarray i64: position % drift_every per detection
+    planted_boundaries: int  # detectable (strictly-interior) boundaries
+    exec_time_s: float  # execution span only (legs AOT-compiled before it)
+
+
+def run_soak_chained(
+    model: Model,
+    ddm_params: DDMParams = DDMParams(),
+    *,
+    partitions: int,
+    per_batch: int,
+    total_rows: int,
+    drift_every: int,
+    max_leg_rows: int = 2**30,
+    generator: str = "prototypes",
+    features: int | None = None,
+    detector=None,
+    key=None,
+    on_leg=None,
+) -> ChainedSoakSummary:
+    """Host driver over :func:`make_soak_chain`: run ≥ ``total_rows`` rows.
+
+    Sizes legs to ``≤ max_leg_rows`` rounded to the drift alignment, runs
+    them back to back with the carried state, and folds each leg's flag
+    table into scalar detection statistics host-side (the full 1e10-row flag
+    table is never materialised). ``on_leg(leg_idx, flags)`` is an optional
+    observer (e.g. checkpointing). Rounds the row count *up* to a whole
+    number of aligned legs.
+
+    Both leg executables are AOT-compiled (``.lower().compile()``) before
+    the measured span — ``exec_time_s`` in the summary covers execution and
+    host-side flag folding only, never compilation, regardless of leg count
+    (the block-offset vector is a runtime argument precisely so one
+    executable serves every chain length).
+    """
+    import math
+    import time
+
+    import numpy as np
+
+    b, p, de = int(per_batch), int(partitions), int(drift_every)
+    # Leg length in batches: smallest multiple of the concept alignment
+    # (L·b ≡ 0 mod drift_every ⇔ L ≡ 0 mod de/gcd(de, b)), capped by
+    # max_leg_rows.
+    align_b = de // math.gcd(de, b)
+    nb_total = max(-(-int(total_rows) // (p * b)), 2)
+    L = max(int(max_leg_rows) // (p * b), align_b)
+    L -= L % align_b
+    L = min(L, -(-nb_total // align_b) * align_b)
+    S = max(-(-nb_total // L), 1)
+
+    impl = _make_soak_chain_impl(
+        model,
+        ddm_params,
+        partitions=p,
+        per_batch=b,
+        batches_per_leg=L,
+        legs=S,
+        drift_every=de,
+        generator=generator,
+        features=features,
+        detector=detector,
+    )
+    if key is None:
+        key = jax.random.key(0)
+
+    first_c = impl.first.lower(key, impl.block0s).compile()
+    next_c = None
+    if S > 1:
+        state_sh = jax.eval_shape(impl.first, key, impl.block0s).state
+        next_c = impl.next.lower(state_sh, jnp.int32(0), impl.block0s).compile()
+
+    detections = 0
+    delays = []
+    start = time.perf_counter()
+    out = first_c(key, impl.block0s)
+    for s in range(S):
+        if s:
+            out = next_c(out.state, jnp.int32(s), impl.block0s)
+        cg = np.asarray(out.flags.change_global)
+        if on_leg is not None:
+            on_leg(s, out.flags)
+        hit = cg[cg >= 0]
+        detections += int(hit.size)
+        if hit.size:
+            delays.append(hit.astype(np.int64) % de)
+    exec_time = time.perf_counter() - start
+
+    t_pp = S * L * b
+    return ChainedSoakSummary(
+        rows_processed=p * t_pp,
+        legs=S,
+        detections=detections,
+        delays=(
+            np.concatenate(delays) if delays else np.empty(0, np.int64)
+        ),
+        planted_boundaries=planted_interior_boundaries(p, t_pp, de),
+        exec_time_s=exec_time,
+    )

@@ -70,7 +70,6 @@ def make_window_span(
     window: int = 16,
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
-    ddm_impl: str = "xla",
     detector=None,
 ):
     """Build ``span(carry: LoopCarry, batches) -> (LoopCarry, FlagRows)``.
@@ -91,25 +90,10 @@ def make_window_span(
     w = int(window)
     assert w >= 1
     det = resolve_detector(ddm_params, detector)
-    if ddm_impl == "pallas":
-        if det.name != "ddm":
-            raise ValueError(
-                f"ddm_impl='pallas' fuses the DDM statistic only; detector "
-                f"{det.name!r} has no Pallas kernel — use ddm_impl='xla'"
-            )
-        from ..ops.ddm_pallas import ddm_window_pallas
-
-        # The kernel's baked params are the single source of truth — a
-        # caller-supplied detector may carry different DDMParams than the
-        # positional ddm_params argument.
-        _pallas_params = det.params
-        _det_window = lambda s, e, v: ddm_window_pallas(  # noqa: E731
-            s, e, v, _pallas_params
-        )
-    elif ddm_impl == "xla":
-        _det_window = det.window
-    else:
-        raise ValueError(f"unknown ddm_impl {ddm_impl!r}; expected 'xla' or 'pallas'")
+    # The window statistic runs as XLA primitives (cumsum + associative_scan,
+    # ops/ddm.py). A fused Pallas twin was measured and removed in round 2 —
+    # numbers in PARITY.md "Pallas post-mortem".
+    _det_window = det.window
 
     def span(
         carry_in: LoopCarry, batches: Batches | IndexedBatches
@@ -295,7 +279,6 @@ def make_window_runner(
     window: int = 16,
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
-    ddm_impl: str = "xla",
     detector=None,
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
@@ -310,7 +293,6 @@ def make_window_runner(
         window=window,
         shuffle=shuffle,
         retrain_error_threshold=retrain_error_threshold,
-        ddm_impl=ddm_impl,
         detector=det,
     )
 

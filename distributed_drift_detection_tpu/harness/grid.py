@@ -146,7 +146,7 @@ def main(argv=None) -> None:
     ap.add_argument("--dataset", default="/root/reference/outdoorStream.csv")
     ap.add_argument("--mults", default="1,2,4")
     ap.add_argument("--partitions", default="1,2,4,8")
-    ap.add_argument("--models", default="linear")
+    ap.add_argument("--models", default="centroid")
     ap.add_argument("--detectors", default="ddm")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--per-batch", type=int, default=100)

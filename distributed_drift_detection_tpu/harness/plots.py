@@ -122,7 +122,12 @@ def render_all(results_csv: str, out_dir: str = "figures") -> dict[str, str]:
     for _, combo in combos.iterrows():
         model, det = combo["Model"], combo["Detector"]
         sub = agg[(agg["Model"] == model) & (agg["Detector"] == det)]
-        suffix = "" if len(combos) == 1 else f"-{model}-{det}"
+        # Rows backfilled from legacy (pre-Model/Detector) CSVs carry the
+        # "-" placeholder; map it to a readable token so filenames don't
+        # degenerate to e.g. "speedup-----.pdf".
+        mtok = "legacy" if model == "-" else model
+        dtok = "legacy" if det == "-" else det
+        suffix = "" if len(combos) == 1 else f"-{mtok}-{dtok}"
         for stem, fn in [
             ("speedup", plot_speedup),
             ("time", plot_time),

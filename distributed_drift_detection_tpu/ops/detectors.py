@@ -25,6 +25,18 @@ uniform :class:`DetectorKernel` seam the engines consume:
   maximum and once ``k ≥ min_num_errors``: warning when ``m2s_k/m2s_max <
   α``, change when ``< β`` (shrinking error distances ⇒ drift).
 
+  **Documented deviation from Baena-García 2006:** the first error after
+  init/reset contributes a distance measured from the stream/reset start
+  (``d = t`` with ``last_err_t = 0``), whereas the paper only measures
+  distances *between consecutive* errors (the first error would merely arm
+  ``last_err_t``). This seeds the mean/std/``m2s_max`` with one synthetic
+  distance per reset. It is deliberate: in the engines' DDM-loop usage the
+  detector is reset at every drift and errors are frequent, so the synthetic
+  distance is small and the ``min_num_errors = 30`` warm-up absorbs it; in
+  exchange every code path (scalar step, batch prefix pass, window pass, and
+  the NumPy test oracle) shares the one uniform ``d = t − last_err_t``
+  recurrence with no seen-an-error flag threaded through the carry.
+
 Both are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
 sums for the running statistics and an ``associative_scan`` for the
@@ -80,8 +92,7 @@ class DetectorKernel(NamedTuple):
     ``[W, B]`` planes returning ``[W]`` result leaves (state flowing across
     batch boundaries, exactly :func:`ops.ddm.ddm_window`'s contract).
     ``params`` is the statistic's hyper-parameter tuple — the single source
-    of truth (the alternate DDM Pallas implementation reads it from here, so
-    both impls of one kernel always share parameters).
+    of truth for any alternate implementation of the same kernel.
     """
 
     name: str

@@ -102,7 +102,6 @@ def make_mesh_runner(
     retrain_error_threshold: float | None = None,
     window: int = 1,
     indexed: bool = False,
-    ddm_impl: str = "xla",
     detector=None,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
@@ -127,11 +126,6 @@ def make_mesh_runner(
         )
     if indexed and window <= 1:
         raise ValueError("indexed batches require the window engine (window > 1)")
-    if ddm_impl != "xla" and window <= 1:
-        raise ValueError(
-            f"ddm_impl={ddm_impl!r} requires the window engine (window > 1); "
-            "the sequential batch-per-step scan only has the XLA detector"
-        )
     if window > 1:
         from ..engine.window import make_window_runner
 
@@ -141,7 +135,6 @@ def make_mesh_runner(
             window=window,
             shuffle=shuffle,
             retrain_error_threshold=retrain_error_threshold,
-            ddm_impl=ddm_impl,
             detector=detector,
         )
     else:

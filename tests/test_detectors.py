@@ -312,18 +312,3 @@ def test_window_engine_matches_sequential(detector):
     b = _api_run(detector, window=8)
     for fa, fb in zip(a.flags, b.flags):
         np.testing.assert_array_equal(fa, fb)
-
-
-def test_pallas_requires_ddm():
-    from distributed_drift_detection_tpu.engine.window import make_window_span
-    from distributed_drift_detection_tpu.models import ModelSpec, make_majority
-
-    det = make_detector("ph", ph=PH)
-    with pytest.raises(ValueError, match="pallas"):
-        make_window_span(
-            make_majority(ModelSpec(4, 3)),
-            None,
-            window=4,
-            ddm_impl="pallas",
-            detector=det,
-        )

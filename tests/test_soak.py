@@ -86,3 +86,82 @@ def test_soak_mesh_sharded_matches_single_device():
     for a, c in zip(single.flags, sharded.flags):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
     assert len(sharded.flags.change_global.sharding.device_set) == 8
+
+
+# --------------------------------------------------------------------------
+# Chained soak (state-carrying legs beyond the int32 ceiling)
+# --------------------------------------------------------------------------
+
+
+def _chain_run(legs, batches_per_leg, **kw):
+    from distributed_drift_detection_tpu.engine.soak import make_soak_chain
+
+    cfg = dict(partitions=4, per_batch=100, drift_every=1000)
+    cfg.update(kw)
+    first, nxt = make_soak_chain(
+        build_model("centroid", ModelSpec(8, 8)),
+        batches_per_leg=batches_per_leg, legs=legs, **cfg,
+    )
+    out = first(jax.random.key(0))
+    flag_parts = [out.flags]
+    for s in range(1, legs):
+        out = nxt(out.state, s)
+        flag_parts.append(out.flags)
+    return jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=1),
+        *flag_parts,
+    )
+
+
+def test_chained_soak_matches_one_shot_bitwise():
+    """A 4-leg chained soak equals the one-shot runner bit-for-bit (modulo
+    the partition row offset: one-shot rows are global, chain rows are
+    partition-local) — the exactness contract of make_soak_chain. Geometry
+    is leg-aligned: 25 batches/leg × 100 rows = 2500 ≡ 0 mod 500, and the
+    per-partition total (100·100) is a multiple of drift_every so the
+    one-shot's global row arithmetic agrees."""
+    one = _run(num_batches=100, drift_every=500)
+    chained = _chain_run(legs=4, batches_per_leg=25, drift_every=500)
+    part_offset = (np.arange(4) * 100 * 100).astype(np.int64)[:, None]
+    for name in one.flags._fields:
+        a = np.asarray(getattr(one.flags, name))
+        b = np.asarray(getattr(chained, name))
+        if name in ("warning_global", "change_global"):
+            # Global-position flags: add the partition offset where flagged.
+            b = np.where(b >= 0, b + part_offset, b)
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_chained_soak_driver_summary():
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+
+    s = run_soak_chained(
+        build_model("centroid", ModelSpec(8, 8)),
+        partitions=4, per_batch=100, total_rows=40_000, drift_every=1000,
+        max_leg_rows=10_000,
+    )
+    assert s.legs >= 2  # the cap forces chaining
+    assert s.rows_processed >= 40_000
+    # prototypes regime: every interior boundary found, row-exact delays.
+    assert s.detections == s.planted_boundaries
+    assert np.percentile(s.delays, 95) <= 2
+
+
+def test_chain_rejects_unaligned_legs():
+    from distributed_drift_detection_tpu.engine.soak import make_soak_chain
+
+    with pytest.raises(ValueError, match="multiple of drift_every"):
+        make_soak_chain(
+            build_model("centroid", ModelSpec(8, 8)),
+            partitions=2, per_batch=100, batches_per_leg=7, legs=2,
+            drift_every=1000,
+        )
+
+
+def test_one_shot_ceiling_points_to_chain():
+    with pytest.raises(ValueError, match="run_soak_chained"):
+        make_soak_runner(
+            build_model("centroid", ModelSpec(8, 8)),
+            partitions=64, per_batch=1000, num_batches=40_000,
+            drift_every=100_000,
+        )
