@@ -10,6 +10,7 @@ from .loop import (
 from .soak import (
     ChainedSoakSummary,
     SoakChainState,
+    SoakLegFlags,
     SoakResult,
     make_soak_chain,
     make_soak_runner,
@@ -32,5 +33,6 @@ __all__ = [
     "make_window_span",
     "run_soak_chained",
     "SoakChainState",
+    "SoakLegFlags",
     "SoakResult",
 ]

@@ -407,6 +407,14 @@ def _make_soak_chain_impl(
             f"per-partition stream of {t_pp:,} rows exceeds int32 positions; "
             "raise `partitions` (the ceiling scales with it)"
         )
+    total_blocks = p * (t_pp // de)
+    if total_blocks > 2**31 - 1:
+        # block0s carries per-partition concept offsets as int32; the last
+        # partition's ids reach p·blocks_pp and would wrap silently.
+        raise ValueError(
+            f"{total_blocks:,} total concept blocks exceed int32 ids; "
+            "raise `drift_every` or lower `partitions`"
+        )
     det = resolve_detector(ddm_params, detector)
     step = make_partition_step(model, ddm_params, shuffle=False, detector=det)
     # Per-partition concept-block offsets. Passed into the jitted legs as a

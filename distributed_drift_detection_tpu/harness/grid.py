@@ -124,14 +124,31 @@ def run_grid(
     trials: int = 5,
     progress=print,
     detectors: list[str] | None = None,
+    warmup: bool = False,
 ) -> int:
-    """Run all missing trials of the sweep; returns number executed."""
+    """Run all missing trials of the sweep; returns number executed.
+
+    ``warmup=True`` executes one *unrecorded* run before each config's first
+    timed trial, so every recorded ``Final Time`` is warm — compile and
+    first-touch device setup stay out of the 5-trial means, matching the
+    reference's warm-cluster methodology (BASELINE.md: its numbers exclude
+    cluster start-up; trials are config-major, so one warm run covers the
+    whole trial block).
+    """
     from ..api import run  # lazy: keeps harness importable without jax init
 
     configs = grid_configs(base, mults, partitions, models, trials, detectors)
     todo = missing_configs(configs)
     progress(f"grid: {len(configs)} trials total, {len(todo)} to run")
+    warmed = None
     for i, cfg in enumerate(todo):
+        static_key = (
+            cfg.dataset, cfg.mult_data, cfg.partitions, cfg.model,
+            cfg.detector, cfg.per_batch, cfg.window,
+        )
+        if warmup and static_key != warmed:
+            run(replace(cfg, results_csv="", time_string="warmup"))
+            warmed = static_key
         res = run(cfg)
         progress(
             f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
@@ -151,6 +168,12 @@ def main(argv=None) -> None:
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--per-batch", type=int, default=100)
     ap.add_argument("--results-csv", default="ddm_cluster_runs.csv")
+    ap.add_argument(
+        "--warmup",
+        action="store_true",
+        help="one unrecorded warm run before each config's timed trials "
+        "(warm-only Final Times; see run_grid)",
+    )
     args = ap.parse_args(argv)
 
     base = RunConfig(
@@ -165,6 +188,7 @@ def main(argv=None) -> None:
         models=args.models.split(","),
         trials=args.trials,
         detectors=args.detectors.split(","),
+        warmup=args.warmup,
     )
 
 

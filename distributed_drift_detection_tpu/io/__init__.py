@@ -1,3 +1,4 @@
+from .datasets import convert_data_labels_to_csv, rialto_fixture_csv
 from .feeder import (
     chunk_stream_arrays,
     csv_chunks,
@@ -24,6 +25,8 @@ from .synth import (
 
 __all__ = [
     "chunk_stream_arrays",
+    "convert_data_labels_to_csv",
+    "rialto_fixture_csv",
     "csv_chunks",
     "generator_chunks",
     "prefetch_chunks",

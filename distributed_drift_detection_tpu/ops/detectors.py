@@ -81,7 +81,11 @@ _INF = jnp.inf
 # a true -inf would produce 0·(-inf) = NaN when an element with alpha = 0
 # follows an identity (invalid/padded) element. Finite, it multiplies and
 # maxes exactly like -inf for every reachable magnitude (|A| ≤ 1, |B| tiny).
-_NO_CLAMP = jnp.float32(-1e30)
+# Python float, not jnp.float32(...): a module-level jnp call would create a
+# device array at import time and initialise the XLA backend — breaking
+# jax.distributed.initialize for any program that imports this package first
+# (multihost rule, parallel/multihost.py). Cast where consumed.
+_NO_CLAMP = float(-1e30)
 
 
 class DetectorKernel(NamedTuple):

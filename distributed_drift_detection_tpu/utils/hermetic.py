@@ -1,0 +1,51 @@
+"""Hermetic CPU environment for subprocess re-execution.
+
+Several entry points must run JAX on a virtual CPU mesh *no matter what the
+host environment wants*: the driver's multi-chip dry run
+(``__graft_entry__.dryrun_multichip`` — whose round-1 artifact recorded a
+failure precisely because a TPU tunnel was probed first), the delay-parity
+harness (``harness.parity``), and the multi-process multihost test. Each
+re-executes itself in a fresh subprocess; this helper builds that
+subprocess's environment in ONE place so every hardening (a new site-hook
+variable, a new platform override) lands everywhere at once.
+
+Three layers of defence:
+
+* ``JAX_PLATFORMS=cpu`` (and dropping the legacy ``JAX_PLATFORM_NAME``);
+* dropping ``PALLAS_AXON_POOL_IPS`` — a site hook keyed on it can pin an
+  accelerator platform via ``jax.config`` at interpreter start, which
+  *outranks* ``JAX_PLATFORMS``;
+* ``--xla_force_host_platform_device_count=N`` in ``XLA_FLAGS`` (any
+  pre-existing count flag is removed first; ``n_devices=None`` removes
+  without re-adding, letting the child pin its own count).
+
+The child should still call ``jax.config.update("jax_platforms", "cpu")``
+before its first backend touch as a belt-and-braces config-level pin (see
+``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Environment variables that can override or outrank JAX_PLATFORMS.
+_PLATFORM_OVERRIDES = ("JAX_PLATFORM_NAME", "PALLAS_AXON_POOL_IPS")
+
+
+def hermetic_cpu_env(
+    n_devices: int | None = None, base: dict | None = None
+) -> dict:
+    """A copy of ``base`` (default ``os.environ``) forced to CPU-only JAX."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    for var in _PLATFORM_OVERRIDES:
+        env.pop(var, None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if n_devices is not None:
+        flags.append(f"--xla_force_host_platform_device_count={int(n_devices)}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

@@ -1,0 +1,77 @@
+"""Dataset helpers (reference C16): the real-rialto schema path.
+
+``rialto.csv`` is missing from the reference repo (``.MISSING_LARGE_BLOBS``)
+but its expected schema is declared at ``DDM_Process.py:33-35``: feature
+columns named ``"0".."26"`` plus ``"target"``. These tests prove a file in
+exactly that schema — built here as a geometry-faithful fixture — loads and
+runs unchanged via ``RunConfig(dataset=<path>)``, and that the public
+``rialto.data``/``rialto.labels`` mirror format converts into it.
+"""
+
+import numpy as np
+
+from distributed_drift_detection_tpu import RunConfig, run
+from distributed_drift_detection_tpu.io import (
+    convert_data_labels_to_csv,
+    load_csv,
+    load_stream,
+    rialto_fixture_csv,
+)
+
+
+def test_rialto_schema_fixture_loads(tmp_path):
+    path = str(tmp_path / "rialto.csv")
+    n, f = rialto_fixture_csv(path, rows_per_class=20)
+    assert (n, f) == (200, 27)
+    with open(path) as fh:
+        header = fh.readline().strip().split(",")
+    assert header == [*map(str, range(27)), "target"]  # DDM_Process.py:33-35
+    X, y = load_csv(path)
+    assert X.shape == (200, 27) and set(np.unique(y)) == set(range(10))
+
+
+def test_rialto_schema_runs_unchanged(tmp_path):
+    """A real-schema rialto CSV goes straight through RunConfig(dataset=...)
+    — the 'accept a dataset=<path> run of it unchanged' contract."""
+    path = str(tmp_path / "rialto.csv")
+    rialto_fixture_csv(path, rows_per_class=100)
+    res = run(
+        RunConfig(
+            dataset=path, mult_data=2, partitions=4, per_batch=50,
+            results_csv="",
+        )
+    )
+    assert res.stream.num_features == 27 and res.stream.num_classes == 10
+    assert res.metrics.num_detections > 0  # planted concepts detected
+
+
+def test_convert_data_labels_pair(tmp_path):
+    """The vlosing/driftDatasets mirror format (whitespace .data + .labels)
+    converts to the reference's single-CSV schema losslessly."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(30, 5)).astype(np.float64)
+    y = rng.integers(0, 3, 30)
+    data, labels = tmp_path / "r.data", tmp_path / "r.labels"
+    np.savetxt(data, X)
+    np.savetxt(labels, y, fmt="%d")
+    out = str(tmp_path / "rialto.csv")
+    n, f = convert_data_labels_to_csv(str(data), str(labels), out)
+    assert (n, f) == (30, 5)
+    X2, y2 = load_csv(out)
+    np.testing.assert_allclose(X2, X.astype(np.float32), rtol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+    # And the converted file flows through the stream pipeline.
+    stream = load_stream(out, mult_data=1)
+    assert stream.num_rows == 30
+
+
+def test_convert_rejects_length_mismatch(tmp_path):
+    data, labels = tmp_path / "r.data", tmp_path / "r.labels"
+    np.savetxt(data, np.zeros((4, 2)))
+    np.savetxt(labels, np.zeros(3), fmt="%d")
+    try:
+        convert_data_labels_to_csv(str(data), str(labels), str(tmp_path / "o.csv"))
+    except ValueError as e:
+        assert "4 rows" in str(e)
+    else:
+        raise AssertionError("length mismatch not rejected")

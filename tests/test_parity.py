@@ -1,0 +1,76 @@
+"""Delay-parity harness: the BASELINE.json "≤ 1-batch change" criterion.
+
+Makes the PARITY.md rf-vs-flagship table checkable by pytest + one command
+(``python -m distributed_drift_detection_tpu.harness.parity`` regenerates
+the committed ``results/delay_parity.csv``). The live test here runs the
+same measurement at CI size: fewer seeds and a smaller forest, same stream
+family and criterion.
+"""
+
+import numpy as np
+
+from distributed_drift_detection_tpu.harness.parity import (
+    check_criterion,
+    measure_delay_parity,
+    summarize,
+    write_csv,
+)
+
+
+def _rows(model, delays, detections=100, partitions=8):
+    return [
+        {
+            "model": model,
+            "seed": i,
+            "mean_delay_batches": d,
+            "mean_delay_rows": d * 100,
+            "detections": detections,
+            "partitions": partitions,
+            "per_batch": 100,
+            "mult_data": 4.0,
+            "dataset": "synth:rialto",
+        }
+        for i, d in enumerate(delays)
+    ]
+
+
+def test_summarize_and_criterion_units():
+    rows = _rows("rf", [50.0, 48.0]) + _rows("centroid", [40.0, 42.0]) + _rows(
+        "slowpoke", [61.0, 59.0]
+    )
+    s = {x.model: x for x in summarize(rows)}
+    assert s["rf"].mean == 49.0 and s["centroid"].mean == 41.0
+    assert abs(s["rf"].std - 1.0) < 1e-9
+    gaps = check_criterion(rows)
+    # centroid is 8 units EARLIER (favourable, passes the one-sided bound);
+    # slowpoke is 11 units later — more than one worker-batch (8) → fails.
+    assert gaps["centroid"] == -8.0 and gaps["slowpoke"] == 11.0
+    assert gaps["centroid"] <= 8 and not gaps["slowpoke"] <= 8
+
+
+def test_flagship_meets_parity_criterion_vs_rf(tmp_path):
+    """Live CI-sized measurement: the flagship detects no more than one
+    worker-batch later than the reference's RandomForest family on the
+    rialto stand-in (it actually detects earlier — PARITY.md)."""
+    partitions = 8
+    rows = measure_delay_parity(
+        models=("rf", "centroid"),
+        mult_data=2.0,
+        partitions=partitions,
+        seeds=range(2),
+        rf_estimators=25,
+    )
+    by_model = {m: [r for r in rows if r["model"] == m] for m in ("rf", "centroid")}
+    for m, rs in by_model.items():
+        assert len(rs) == 2
+        assert all(np.isfinite(r["mean_delay_batches"]) for r in rs), m
+        assert all(r["detections"] > 0 for r in rs), m
+    gap = check_criterion(rows)["centroid"]
+    assert gap <= partitions, (
+        f"flagship detects {gap:.1f} global batches later than rf — "
+        f"beyond one worker-batch ({partitions})"
+    )
+    # Round-trip the artifact writer on the measured rows.
+    out = tmp_path / "delay_parity.csv"
+    write_csv(rows, str(out))
+    assert out.read_text().count("\n") == len(rows) + 1
