@@ -508,6 +508,21 @@ def make_soak_chain(*args, **kwargs):
     return first_leg, next_leg
 
 
+def _materialize_like(sds):
+    """A zero-filled concrete array matching a ``ShapeDtypeStruct`` — the
+    structural template ``utils.checkpoint.load_checkpoint`` needs, built
+    without executing a leg. Typed PRNG keys are wrapped from zero key
+    data (the checkpoint stores keys as key data, so impl must only match
+    the default)."""
+    if jnp.issubdtype(sds.dtype, jax.dtypes.prng_key):
+        impl = jax.random.key_impl(jax.random.key(0))
+        data_shape = jax.eval_shape(jax.random.key_data, sds).shape
+        return jax.random.wrap_key_data(
+            jnp.zeros(data_shape, jnp.uint32), impl=impl
+        )
+    return jnp.zeros(sds.shape, sds.dtype)
+
+
 def planted_interior_boundaries(
     partitions: int, rows_per_partition: int, drift_every: int
 ) -> int:
@@ -547,6 +562,7 @@ def run_soak_chained(
     detector=None,
     key=None,
     on_leg=None,
+    checkpoint_path: str = "",
 ) -> ChainedSoakSummary:
     """Host driver over :func:`make_soak_chain`: run ≥ ``total_rows`` rows.
 
@@ -554,19 +570,35 @@ def run_soak_chained(
     them back to back with the carried state, and folds each leg's flag
     table into scalar detection statistics host-side (the full 1e10-row flag
     table is never materialised). ``on_leg(leg_idx, flags)`` is an optional
-    observer (e.g. checkpointing). Rounds the row count *up* to a whole
-    number of aligned legs.
+    observer. Rounds the row count *up* to a whole number of aligned legs.
 
     Both leg executables are AOT-compiled (``.lower().compile()``) before
     the measured span — ``exec_time_s`` in the summary covers execution and
     host-side flag folding only, never compilation, regardless of leg count
     (the block-offset vector is a runtime argument precisely so one
     executable serves every chain length).
+
+    ``checkpoint_path`` turns on crash recovery for long chains (aux
+    subsystem, SURVEY.md §5 — strictly more than the reference's re-run-
+    everything story): after every completed leg, the full chain state (the
+    carried :class:`SoakChainState` pytree) plus accumulated detection
+    statistics are written atomically to the path; a rerun with the *same
+    geometry* resumes at the first unfinished leg and returns the same
+    summary an uninterrupted run would (tested), with ``exec_time_s``
+    covering only the resumed span. A geometry mismatch (different leg
+    sizing, generator, drift spacing, or detector name/parameters) fails
+    loudly rather than resuming garbage. ``on_leg`` fires *before* a leg's
+    checkpoint is written — at-least-once delivery: a crash inside the
+    observer re-runs that leg (and re-delivers its flags) on resume. The
+    file is removed on successful completion.
     """
     import math
+    import os
     import time
 
     import numpy as np
+
+    from ..utils.checkpoint import load_checkpoint, save_checkpoint
 
     b, p, de = int(per_batch), int(partitions), int(drift_every)
     # Leg length in batches: smallest multiple of the concept alignment
@@ -594,27 +626,73 @@ def run_soak_chained(
     if key is None:
         key = jax.random.key(0)
 
+    state_sh = jax.eval_shape(impl.first, key, impl.block0s).state
     first_c = impl.first.lower(key, impl.block0s).compile()
     next_c = None
     if S > 1:
-        state_sh = jax.eval_shape(impl.first, key, impl.block0s).state
         next_c = impl.next.lower(state_sh, jnp.int32(0), impl.block0s).compile()
 
-    detections = 0
-    delays = []
+    det = resolve_detector(ddm_params, detector)
+    geometry = {
+        "p": p, "b": b, "L": L, "S": S, "de": de,
+        "generator": generator,
+        # Name AND full parameter tuple: shapes alone can't tell a resumed
+        # chain that its detector thresholds changed between runs.
+        "detector": det.name,
+        "detector_params": [float(v) for v in det.params],
+    }
+    detections, delays, start_leg, state = 0, [], 0, None
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        template = jax.tree.map(_materialize_like, state_sh)
+        state, meta = load_checkpoint(checkpoint_path, template)
+        got = {k: meta.get(k) for k in geometry}
+        if got != geometry:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written by a different "
+                f"chain geometry ({got} != {geometry}); delete it or match "
+                "the original configuration"
+            )
+        start_leg = int(meta["next_leg"])
+        detections = int(meta["detections"])
+        if meta["delays"]:
+            delays.append(np.asarray(meta["delays"], np.int64))
+
     start = time.perf_counter()
-    out = first_c(key, impl.block0s)
-    for s in range(S):
-        if s:
-            out = next_c(out.state, jnp.int32(s), impl.block0s)
+    out = None
+    for s in range(start_leg, S):
+        if s == 0:
+            out = first_c(key, impl.block0s)
+        else:
+            out = next_c(
+                (state if out is None else out.state), jnp.int32(s), impl.block0s
+            )
         cg = np.asarray(out.flags.change_global)
-        if on_leg is not None:
-            on_leg(s, out.flags)
         hit = cg[cg >= 0]
         detections += int(hit.size)
         if hit.size:
             delays.append(hit.astype(np.int64) % de)
+        # Observer BEFORE the checkpoint marks the leg complete: a crash
+        # inside on_leg re-runs the leg on resume and delivers its flags
+        # again (at-least-once; a post-checkpoint crash would silently drop
+        # them, as the checkpoint does not carry flag tables).
+        if on_leg is not None:
+            on_leg(s, out.flags)
+        if checkpoint_path:
+            tmp = checkpoint_path + ".tmp"
+            save_checkpoint(
+                tmp,
+                out.state,
+                meta={
+                    **geometry,
+                    "next_leg": s + 1,
+                    "detections": detections,
+                    "delays": np.concatenate(delays).tolist() if delays else [],
+                },
+            )
+            os.replace(tmp, checkpoint_path)
     exec_time = time.perf_counter() - start
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        os.remove(checkpoint_path)
 
     t_pp = S * L * b
     return ChainedSoakSummary(

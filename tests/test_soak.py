@@ -1,5 +1,7 @@
 """Device-native soak engine: drift-locking and determinism."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -164,4 +166,89 @@ def test_one_shot_ceiling_points_to_chain():
             build_model("centroid", ModelSpec(8, 8)),
             partitions=64, per_batch=1000, num_batches=40_000,
             drift_every=100_000,
+        )
+
+
+def test_chained_soak_checkpoint_resume(tmp_path):
+    """A chain killed mid-run resumes from its checkpoint and returns the
+    same detections/delays an uninterrupted run produces."""
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+
+    kw = dict(
+        partitions=4, per_batch=100, total_rows=40_000, drift_every=1000,
+        max_leg_rows=10_000,
+    )
+    model = build_model("centroid", ModelSpec(8, 8))
+    clean = run_soak_chained(model, **kw)
+    assert clean.legs >= 2
+
+    ckpt = str(tmp_path / "chain.npz")
+
+    class Bomb(RuntimeError):
+        pass
+
+    def explode_in_second_leg(s, flags):
+        # on_leg fires BEFORE the leg's checkpoint (at-least-once observer
+        # contract), so bombing leg 1 leaves exactly leg 0 persisted.
+        if s == 1:
+            raise Bomb()
+
+    with pytest.raises(Bomb):
+        run_soak_chained(
+            model, **kw, checkpoint_path=ckpt, on_leg=explode_in_second_leg
+        )
+    assert os.path.exists(ckpt)  # leg 0 was persisted before the crash
+
+    # Resume re-delivers the bombed leg to the observer (at-least-once).
+    seen = []
+    resumed_probe = run_soak_chained(
+        model, **kw, checkpoint_path=ckpt, on_leg=lambda s, f: seen.append(s)
+    )
+    assert seen[0] == 1 and resumed_probe.detections == clean.detections
+
+    # Re-crash to restore the mid-run checkpoint for the final resume check.
+    with pytest.raises(Bomb):
+        run_soak_chained(
+            model, **kw, checkpoint_path=ckpt, on_leg=explode_in_second_leg
+        )
+
+    resumed = run_soak_chained(model, **kw, checkpoint_path=ckpt)
+    assert resumed.detections == clean.detections
+    np.testing.assert_array_equal(resumed.delays, clean.delays)
+    assert not os.path.exists(ckpt)  # removed on success
+
+
+def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+
+    model = build_model("centroid", ModelSpec(8, 8))
+    ckpt = str(tmp_path / "chain.npz")
+
+    class Bomb(RuntimeError):
+        pass
+
+    def bomb(s, flags):
+        if s == 1:  # leg 0's checkpoint must exist before the crash
+            raise Bomb()
+
+    with pytest.raises(Bomb):
+        run_soak_chained(
+            model, partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=1000, max_leg_rows=10_000,
+            checkpoint_path=ckpt, on_leg=bomb,
+        )
+    assert os.path.exists(ckpt)
+    with pytest.raises(ValueError, match="different[\\s\\S]*geometry"):
+        run_soak_chained(
+            model, partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=500,  # different concept spacing
+            max_leg_rows=10_000, checkpoint_path=ckpt,
+        )
+    from distributed_drift_detection_tpu.config import DDMParams
+
+    with pytest.raises(ValueError, match="different[\\s\\S]*geometry"):
+        run_soak_chained(
+            model, DDMParams(out_control_level=3.0),  # changed thresholds
+            partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
         )
