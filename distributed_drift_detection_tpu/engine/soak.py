@@ -136,6 +136,20 @@ _GENERATORS = {
 }
 
 
+def _mesh_sharding(model: Model, mesh, partitions: int):
+    """Validated partition-axis sharding for a soak engine; ``None`` without
+    a mesh. Shared by the one-shot runner and the chain so the sharding
+    invariant (divisibility check + host-callback rejection) can't diverge
+    between them."""
+    if mesh is None:
+        return None
+    from ..models.base import require_shardable
+    from ..parallel.mesh import partition_sharding
+
+    require_shardable(model, mesh)
+    return partition_sharding(mesh, partitions)
+
+
 def make_soak_runner(
     model: Model,
     ddm_params: DDMParams = DDMParams(),
@@ -284,14 +298,7 @@ def make_soak_runner(
             lambda x: x.reshape(num_chunks * cb, *x.shape[2:])[:nbf], flags
         )
 
-    if mesh is not None:
-        from ..models.base import require_shardable
-        from ..parallel.mesh import partition_sharding
-
-        require_shardable(model, mesh)
-        sh = partition_sharding(mesh, p)
-    else:
-        sh = None
+    sh = _mesh_sharding(model, mesh, p)
 
     def run(key: jax.Array) -> SoakResult:
         keys = jax.random.split(key, p)
@@ -352,6 +359,7 @@ def _make_soak_chain_impl(
     generator: str = "prototypes",
     features: int | None = None,
     detector=None,
+    mesh=None,
 ):
     """Build the state-carrying chained soak (impl form — use
     :func:`make_soak_chain` for the bound ``(first_leg, next_leg)`` pair).
@@ -385,6 +393,12 @@ def _make_soak_chain_impl(
     Sequential engine only (``window=1``): at soak geometry each sequential
     step is already chunky and speculation loses (see
     :func:`make_soak_runner`'s window note). ``jax.jit`` both returns.
+
+    ``mesh`` shards the partition axis across devices exactly like every
+    other engine (the one-shot soak's pattern: generation included, each
+    device synthesises only its own partitions' rows; state and flag
+    outputs come back partition-sharded, so the carried chain state never
+    gathers to one device between legs).
     """
     try:
         gen, default_f = _GENERATORS[generator]
@@ -464,22 +478,30 @@ def _make_soak_chain_impl(
         )
         return carry, flags
 
+    sh = _mesh_sharding(model, mesh, p)
+
+    def _constrain(x):
+        return lax.with_sharding_constraint(x, sh) if sh is not None else x
+
     def first_leg_impl(key: jax.Array, block0s: jax.Array) -> SoakLegFlags:
-        keys = jax.random.split(key, p)
-        carry, gen_keys, flags = jax.vmap(first_one)(keys, block0s)
+        keys = _constrain(jax.random.split(key, p))
+        carry, gen_keys, flags = jax.vmap(first_one)(keys, _constrain(block0s))
         return SoakLegFlags(SoakChainState(carry, gen_keys), flags)
 
     def next_leg_impl(
         state: SoakChainState, leg_idx: jax.Array, block0s: jax.Array
     ) -> SoakLegFlags:
         carry, flags = jax.vmap(next_one, in_axes=(0, 0, 0, None))(
-            state.carry, state.gen_keys, block0s, leg_idx
+            state.carry, state.gen_keys, _constrain(block0s), leg_idx
         )
         return SoakLegFlags(SoakChainState(carry, state.gen_keys), flags)
 
+    # Every output leaf carries a leading partition axis, so one sharding
+    # broadcasts as the out_shardings prefix for the whole SoakLegFlags tree.
+    jit_kw = {} if sh is None else {"out_shardings": sh}
     return _SoakChainImpl(
-        first=jax.jit(first_leg_impl),
-        next=jax.jit(next_leg_impl),
+        first=jax.jit(first_leg_impl, **jit_kw),
+        next=jax.jit(next_leg_impl, **jit_kw),
         block0s=block0s,
     )
 
@@ -560,6 +582,7 @@ def run_soak_chained(
     generator: str = "prototypes",
     features: int | None = None,
     detector=None,
+    mesh=None,
     key=None,
     on_leg=None,
     checkpoint_path: str = "",
@@ -622,6 +645,7 @@ def run_soak_chained(
         generator=generator,
         features=features,
         detector=detector,
+        mesh=mesh,
     )
     if key is None:
         key = jax.random.key(0)

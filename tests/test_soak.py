@@ -252,3 +252,55 @@ def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
             partitions=4, per_batch=100, total_rows=40_000,
             drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
         )
+
+
+def test_chained_soak_mesh_sharded_matches_single_device():
+    """The chain takes a mesh like every other engine: sharded legs produce
+    the same flags, and the carried state stays partition-sharded between
+    legs (never gathered to one device)."""
+    from distributed_drift_detection_tpu.engine.soak import make_soak_chain
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh
+
+    def collect(mesh):
+        first, nxt = make_soak_chain(
+            build_model("centroid", ModelSpec(8, 8)),
+            partitions=8, per_batch=100, batches_per_leg=30, legs=3,
+            drift_every=1000, mesh=mesh,
+        )
+        out = first(jax.random.key(0))
+        parts = [out.flags]
+        for s in range(1, 3):
+            out = nxt(out.state, s)
+            parts.append(out.flags)
+        return out, jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=1),
+            *parts,
+        )
+
+    _, single = collect(None)
+    out, sharded = collect(make_mesh(8))
+    for name in single._fields:
+        np.testing.assert_array_equal(
+            getattr(single, name), getattr(sharded, name), err_msg=name
+        )
+    assert len(out.state.gen_keys.sharding.device_set) == 8
+    assert len(out.flags.change_global.sharding.device_set) == 8
+
+
+def test_chained_soak_driver_on_mesh():
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh
+
+    single = run_soak_chained(
+        build_model("centroid", ModelSpec(8, 8)),
+        partitions=8, per_batch=100, total_rows=80_000, drift_every=1000,
+        max_leg_rows=20_000,
+    )
+    sharded = run_soak_chained(
+        build_model("centroid", ModelSpec(8, 8)),
+        partitions=8, per_batch=100, total_rows=80_000, drift_every=1000,
+        max_leg_rows=20_000, mesh=make_mesh(8),
+    )
+    assert sharded.legs == single.legs >= 2
+    assert sharded.detections == single.detections
+    np.testing.assert_array_equal(sharded.delays, single.delays)
