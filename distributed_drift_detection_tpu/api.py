@@ -34,7 +34,7 @@ from .io.stream import (
     StreamData,
     load_stream,
     stripe_partitions,
-    stripe_partitions_indexed,
+    stripe_partitions_packed,
 )
 from .metrics import DelayMetrics, delay_metrics, result_row
 from .models import ModelSpec, build_model
@@ -81,7 +81,7 @@ def _cached_runner(
             shuffle=False,  # batches are shuffled host-side at stripe time
             retrain_error_threshold=cfg.retrain_error_threshold,
             window=cfg.window,
-            indexed=indexed,
+            packed=indexed,  # compressed stream ships in the packed form
             detector=make_detector(
                 cfg.detector, ddm=cfg.ddm, ph=cfg.ph, eddm=cfg.eddm
             ),
@@ -116,12 +116,15 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # each batch is visited once, so this is semantically identical to an
     # in-loop shuffle but free on device (see io.stream.stripe_chunk).
     # Streams synthesized by duplication keep a compressed (row table + index
-    # planes) form; ship that across the host→device link instead of the
-    # materialized stream — identical flags, ~14× less transfer at mult=512.
+    # planes) form; ship that across the host→device link in its *packed*
+    # variant (row table + gather indices + 1-byte shuffle perms; the
+    # geometry planes are synthesized in-jit) — identical flags, ~30× less
+    # transfer than the materialized stream at mult=512 (~2.3× less than
+    # the round-1 indexed form).
     # window == 0 → auto-size from the stream's planted drift spacing.
     cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
     indexed = stream.src is not None and cfg.window > 1
-    striper = stripe_partitions_indexed if indexed else stripe_partitions
+    striper = stripe_partitions_packed if indexed else stripe_partitions
     batches = striper(
         stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
     )

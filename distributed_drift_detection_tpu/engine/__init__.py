@@ -4,6 +4,8 @@ from .loop import (
     FlagRows,
     IndexedBatches,
     LoopCarry,
+    PackedIndexedBatches,
+    expand_packed,
     make_partition_runner,
     make_partition_step,
 )
@@ -24,6 +26,8 @@ __all__ = [
     "ChunkedDetector",
     "FlagRows",
     "IndexedBatches",
+    "PackedIndexedBatches",
+    "expand_packed",
     "LoopCarry",
     "make_partition_runner",
     "make_partition_step",

@@ -72,6 +72,46 @@ class IndexedBatches(NamedTuple):
     valid: jax.Array  # [NB, B] bool (False = padding)
 
 
+class PackedIndexedBatches(NamedTuple):
+    """Transport-optimal form of :class:`IndexedBatches`.
+
+    The ``rows`` and ``valid`` planes of the compressed stream (12 MB of
+    its ~14 MB at the mult=512 headline shape) are pure functions of the
+    stripe geometry and the per-microbatch shuffle permutation
+    (``io.stream._stripe_maps``: ``gmap = (slot·B + perm)·P + part``,
+    ``rows = gmap``, ``valid = gmap < n``). On a latency/bandwidth-bound
+    host→device link there is no reason to ship them: this form carries
+    only the data-dependent planes — the row-table gather indices and the
+    one-byte permutation — and :func:`expand_packed` synthesizes the rest
+    on device inside the jitted runner, where the arithmetic is free.
+    Expansion is bit-identical to the host-built planes (tested), so every
+    engine downstream is unchanged.
+    """
+
+    base_X: jax.Array  # [T, F] f32 row table (replicated across the mesh)
+    base_y: jax.Array  # [T] i32
+    idx: jax.Array  # [P, NB, B] i16/i32 row-table index (sharded)
+    perm: jax.Array  # [P, NB, B] u8/i16 within-batch shuffle permutation
+    n_rows: jax.Array  # i32 scalar: stream length (pads the validity mask)
+
+
+def expand_packed(packed: PackedIndexedBatches) -> IndexedBatches:
+    """Synthesize the ``rows``/``valid`` planes on device (see
+    :class:`PackedIndexedBatches`). Matches ``io.stream._stripe_maps`` for
+    ``start_row = 0`` — the one-shot path this form serves."""
+    p, nb, b = packed.idx.shape
+    slot = jnp.arange(nb, dtype=jnp.int32)[None, :, None]
+    part = jnp.arange(p, dtype=jnp.int32)[:, None, None]
+    gmap = (slot * b + packed.perm.astype(jnp.int32)) * p + part
+    return IndexedBatches(
+        base_X=packed.base_X,
+        base_y=packed.base_y,
+        idx=packed.idx,
+        rows=gmap,
+        valid=gmap < packed.n_rows,
+    )
+
+
 class FlagRows(NamedTuple):
     """Per-batch detection flags — reference output schema (−1 sentinels),
     plus ``forced_retrain`` marking fallback retrains (see

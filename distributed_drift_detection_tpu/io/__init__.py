@@ -12,6 +12,7 @@ from .stream import (
     materialize_batches,
     stripe_partitions,
     stripe_partitions_indexed,
+    stripe_partitions_packed,
     synthesize_stream,
 )
 from .synth import (
@@ -36,6 +37,7 @@ __all__ = [
     "materialize_batches",
     "stripe_partitions",
     "stripe_partitions_indexed",
+    "stripe_partitions_packed",
     "synthesize_stream",
     "as_stream",
     "hyperplane_chunk",

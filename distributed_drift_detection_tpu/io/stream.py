@@ -32,7 +32,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-from ..engine.loop import Batches, IndexedBatches
+from ..engine.loop import Batches, IndexedBatches, PackedIndexedBatches
 
 
 class StreamData:
@@ -238,21 +238,36 @@ def _stripe_maps(
         "stripe-time shuffle needs start_row aligned to partitions*per_batch "
         "(all regular chunk boundaries are); pass shuffle_seed=None otherwise"
     )
-    slot = np.arange(nb, dtype=np.int64)[None, :, None]
-    part = np.arange(p, dtype=np.int64)[:, None, None]
-    if shuffle_seed is None:
-        j = np.arange(b, dtype=np.int64)[None, None, :]
-        gmap = (slot * b + j) * p + part  # [P, NB, B]
-    else:
-        from ..utils.prng import row_uniforms
-
-        start_slot = start_row // (p * b)
-        u = row_uniforms(shuffle_seed, start_slot * p, nb * p, b, stream_id=3)
-        perms = np.argsort(u.reshape(nb, p, b), axis=-1).swapaxes(0, 1)
-        gmap = (slot * b + perms) * p + part
+    perms = _stripe_perms(p, b, nb, shuffle_seed, start_row // (p * b))
+    gmap = _stripe_gmap(perms)
     rows = (start_row + gmap).astype(np.int32)
     valid = gmap < n
     return gmap, rows, valid
+
+
+def _stripe_perms(
+    p: int, b: int, nb: int, shuffle_seed: int | None, start_slot: int = 0
+) -> np.ndarray:
+    """Within-batch shuffle permutations ``[P, NB, B]`` (identity when
+    unshuffled); counter-based on the absolute batch slot so chunking is
+    invariant (``DDM_Process.py:187,190`` semantics, seeded)."""
+    if shuffle_seed is None:
+        j = np.arange(b, dtype=np.int64)
+        return np.broadcast_to(j, (p, nb, b))
+    from ..utils.prng import row_uniforms
+
+    u = row_uniforms(shuffle_seed, start_slot * p, nb * p, b, stream_id=3)
+    return np.argsort(u.reshape(nb, p, b), axis=-1).swapaxes(0, 1)
+
+
+def _stripe_gmap(perms: np.ndarray) -> np.ndarray:
+    """``gmap[p, s, j] = (s·B + perm[p, s, j])·P + p`` — the stripe gather
+    (C8 ``:225`` placement composed with the per-batch shuffle). The same
+    formula is replayed on device by ``engine.loop.expand_packed``."""
+    p, nb, b = perms.shape
+    slot = np.arange(nb, dtype=np.int64)[None, :, None]
+    part = np.arange(p, dtype=np.int64)[:, None, None]
+    return (slot * b + perms) * p + part
 
 
 def stripe_partitions(
@@ -291,25 +306,68 @@ def stripe_partitions_indexed(
     ``materialize_batches`` reproduces the exact :class:`Batches` for parity
     checks. Requires a stream synthesized with ``mult_data >= 1``.
     """
+    # One construction for both compressed forms: build packed, expand the
+    # geometry planes host-side (the exact formula expand_packed replays on
+    # device), so the two stripers cannot drift apart.
+    packed = stripe_partitions_packed(
+        stream, partitions, per_batch, shuffle_seed=shuffle_seed
+    )
+    gmap = _stripe_gmap(np.asarray(packed.perm, dtype=np.int64))
+    return IndexedBatches(
+        base_X=packed.base_X,
+        base_y=packed.base_y,
+        idx=packed.idx,
+        rows=gmap.astype(np.int32),
+        valid=gmap < int(packed.n_rows),
+    )
+
+
+def stripe_partitions_packed(
+    stream: StreamData,
+    partitions: int,
+    per_batch: int,
+    shuffle_seed: int | None = None,
+) -> PackedIndexedBatches:
+    """Transport-optimal variant of :func:`stripe_partitions_indexed`.
+
+    Same placement, same shuffle, same downstream flags — but the
+    geometry-derived ``rows``/``valid`` planes are *not built or shipped*:
+    only the row-table gather indices and the one-byte-per-element shuffle
+    permutation cross the host→device link, and the planes are synthesized
+    in-jit by ``engine.loop.expand_packed`` (~2.3× less transfer than the
+    indexed form at the mult=512 headline shape). One-shot path only
+    (``start_row = 0``).
+    """
     if stream.src is None:
         raise ValueError(
             "stream has no compressed form (subsampled or hand-built); "
             "use stripe_partitions"
         )
     n = stream.num_rows
-    per_part = -(-n // partitions)
-    nb = -(-per_part // per_batch)
-    gmap, rows, valid = _stripe_maps(
-        n, 0, partitions, per_batch, nb, shuffle_seed
-    )
-    idx = _pad(stream.src.astype(np.int64), partitions * nb * per_batch, 0)[gmap]
+    p, b = partitions, per_batch
+    per_part = -(-n // p)
+    nb = -(-per_part // b)
+    if p * nb * b > 2**31 - 1:
+        raise ValueError(
+            f"padded stripe grid of {p * nb * b:,} positions exceeds int32 "
+            "(expand_packed synthesizes positions as int32)"
+        )
+    perms = _stripe_perms(p, b, nb, shuffle_seed)
+    idx = _pad(stream.src.astype(np.int64), p * nb * b, 0)[_stripe_gmap(perms)]
     dt = np.int16 if len(stream.base_y) <= np.iinfo(np.int16).max else np.int32
-    return IndexedBatches(
+    # Smallest lossless dtype for the in-batch permutation (values < b).
+    if b <= 256:
+        pdt = np.uint8
+    elif b <= np.iinfo(np.int16).max + 1:
+        pdt = np.int16
+    else:
+        pdt = np.int32
+    return PackedIndexedBatches(
         base_X=stream.base_X,
         base_y=stream.base_y,
         idx=idx.astype(dt),
-        rows=rows,
-        valid=valid,
+        perm=np.ascontiguousarray(perms.astype(pdt)),
+        n_rows=np.int32(n),
     )
 
 

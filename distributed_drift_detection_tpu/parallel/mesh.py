@@ -37,6 +37,8 @@ from ..engine.loop import (
     Batches,
     FlagRows,
     IndexedBatches,
+    PackedIndexedBatches,
+    expand_packed,
     make_partition_runner,
 )
 from ..models.base import Model
@@ -102,6 +104,7 @@ def make_mesh_runner(
     retrain_error_threshold: float | None = None,
     window: int = 1,
     indexed: bool = False,
+    packed: bool = False,
     detector=None,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
@@ -115,10 +118,16 @@ def make_mesh_runner(
     batch-per-step sequential scan. ``indexed=True`` builds the runner for
     :class:`IndexedBatches` (compressed stream: row table replicated across
     the mesh, index planes sharded; requires ``window > 1``).
+    ``packed=True`` (implies ``indexed``) accepts
+    :class:`PackedIndexedBatches` and synthesizes the geometry planes
+    in-jit (``expand_packed``) before the engines see them — the engines
+    and their flags are identical, only the host→device transfer shrinks.
     """
     from ..models.base import require_shardable
 
     require_shardable(model, mesh)
+    packed_mode = packed
+    indexed = indexed or packed_mode
     if window == 0:
         raise ValueError(
             "window=0 (auto) needs stream geometry and is resolved by "
@@ -153,6 +162,11 @@ def make_mesh_runner(
     vmapped = jax.vmap(run_one, in_axes=(batch_axes, 0))
 
     def run(batches, keys: jax.Array) -> MeshRunResult:
+        if packed_mode:
+            # Synthesize the geometry planes on device: 1-byte perms in,
+            # int32 rows + validity mask out — engines see the exact
+            # IndexedBatches the host striper would have built.
+            batches = expand_packed(batches)
         flags = vmapped(batches, keys)
         changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
         # Cross-partition reduction: lowers to an ICI all-reduce when the
@@ -168,7 +182,12 @@ def make_mesh_runner(
 
     data_sharding = partition_sharding(mesh)
     replicated = NamedSharding(mesh, P())
-    if indexed:
+    if packed_mode:
+        in_batches = PackedIndexedBatches(
+            base_X=replicated, base_y=replicated,
+            idx=data_sharding, perm=data_sharding, n_rows=replicated,
+        )
+    elif indexed:
         in_batches = IndexedBatches(
             replicated, replicated, data_sharding, data_sharding, data_sharding
         )
@@ -194,8 +213,17 @@ def shard_batches(batches, keys: jax.Array, mesh: Mesh | None):
     if mesh is None:
         return jax.device_put(batches), jax.device_put(keys)
     sh = partition_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+    if isinstance(batches, PackedIndexedBatches):
+        placed = PackedIndexedBatches(
+            base_X=jax.device_put(batches.base_X, rep),
+            base_y=jax.device_put(batches.base_y, rep),
+            idx=jax.device_put(batches.idx, sh),
+            perm=jax.device_put(batches.perm, sh),
+            n_rows=jax.device_put(batches.n_rows, rep),
+        )
+        return placed, jax.device_put(keys, sh)
     if isinstance(batches, IndexedBatches):
-        rep = NamedSharding(mesh, P())
         placed = IndexedBatches(
             base_X=jax.device_put(batches.base_X, rep),
             base_y=jax.device_put(batches.base_y, rep),

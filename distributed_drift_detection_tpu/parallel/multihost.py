@@ -120,8 +120,19 @@ def local_stripe(batches, keys: jax.Array, sl: slice):
     Sharded planes are cut to ``sl``; an :class:`IndexedBatches` row table is
     replicated, so it passes through whole.
     """
-    from ..engine.loop import IndexedBatches
+    from ..engine.loop import IndexedBatches, PackedIndexedBatches
 
+    if isinstance(batches, PackedIndexedBatches):
+        return (
+            PackedIndexedBatches(
+                base_X=batches.base_X,
+                base_y=batches.base_y,
+                idx=batches.idx[sl],
+                perm=batches.perm[sl],
+                n_rows=batches.n_rows,
+            ),
+            keys[sl],
+        )
     if isinstance(batches, IndexedBatches):
         return (
             IndexedBatches(
@@ -186,9 +197,17 @@ def shard_batches_global(
         out = jax.make_array_from_process_local_data(sharding, x, global_shape)
         return jax.random.wrap_key_data(out, impl=impl) if is_key else out
 
-    from ..engine.loop import IndexedBatches
+    from ..engine.loop import IndexedBatches, PackedIndexedBatches
 
-    if isinstance(batches, IndexedBatches):
+    if isinstance(batches, PackedIndexedBatches):
+        placed = PackedIndexedBatches(
+            base_X=put(batches.base_X, replicated),
+            base_y=put(batches.base_y, replicated),
+            idx=put(batches.idx, sharded),
+            perm=put(batches.perm, sharded),
+            n_rows=put(batches.n_rows, replicated),
+        )
+    elif isinstance(batches, IndexedBatches):
         placed = IndexedBatches(
             base_X=put(batches.base_X, replicated),
             base_y=put(batches.base_y, replicated),

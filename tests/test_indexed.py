@@ -101,3 +101,69 @@ def test_api_run_uses_indexed_path_and_matches_dense():
     )
     assert fast.metrics.num_detections == slow.metrics.num_detections > 0
     np.testing.assert_array_equal(fast.metrics.delays, slow.metrics.delays)
+
+
+# --------------------------------------------------------------------------
+# Packed form (geometry planes synthesized on device)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shuffle_seed", [None, 7])
+def test_packed_expands_to_indexed_bitwise(shuffle_seed):
+    """expand_packed must rebuild exactly the planes the host striper would
+    have shipped — including the ragged padded tail."""
+    from distributed_drift_detection_tpu.engine import expand_packed
+    from distributed_drift_detection_tpu.io import stripe_partitions_packed
+
+    s = small_stream(mult=6)
+    p, b = 4, 11  # ragged grid: pad slots exercise the validity mask
+    indexed = stripe_partitions_indexed(s, p, b, shuffle_seed=shuffle_seed)
+    packed = stripe_partitions_packed(s, p, b, shuffle_seed=shuffle_seed)
+    assert packed.perm.dtype == np.uint8  # b=11 ≤ 256 → one byte per element
+    expanded = jax.jit(expand_packed)(packed)
+    for name in indexed._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(indexed, name)),
+            np.asarray(getattr(expanded, name)),
+            err_msg=name,
+        )
+
+
+def test_mesh_runner_packed_equals_indexed_sharded():
+    """The packed transport changes nothing observable, sharded or not."""
+    from distributed_drift_detection_tpu.io import stripe_partitions_packed
+    from distributed_drift_detection_tpu.parallel.mesh import (
+        make_mesh,
+        make_mesh_runner,
+        shard_batches,
+    )
+
+    s = small_stream(mult=8, seed=2)  # 960 rows
+    p, b, seed = 8, 10, 9
+    indexed = stripe_partitions_indexed(s, p, b, shuffle_seed=seed)
+    packed = stripe_partitions_packed(s, p, b, shuffle_seed=seed)
+    model = build_model("centroid", ModelSpec(s.num_features, s.num_classes))
+    keys = jax.random.split(jax.random.key(0), p)
+
+    outs = {}
+    for mesh in (None, make_mesh(8)):
+        r_idx = make_mesh_runner(
+            model, DDMParams(), mesh, shuffle=False, window=4, indexed=True
+        )
+        r_pk = make_mesh_runner(
+            model, DDMParams(), mesh, shuffle=False, window=4, packed=True
+        )
+        di, ki = shard_batches(indexed, keys, mesh)
+        dp, kp = shard_batches(packed, keys, mesh)
+        outs[mesh is None] = (r_idx(di, ki), r_pk(dp, kp))
+    for _, (oi, op) in outs.items():
+        np.testing.assert_array_equal(
+            np.asarray(oi.packed), np.asarray(op.packed)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(oi.drift_vote), np.asarray(op.drift_vote)
+        )
+    # sharded == unsharded for the packed path too
+    np.testing.assert_array_equal(
+        np.asarray(outs[True][1].packed), np.asarray(outs[False][1].packed)
+    )
