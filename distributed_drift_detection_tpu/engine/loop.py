@@ -59,7 +59,8 @@ class IndexedBatches(NamedTuple):
     the scarce resource, so the framework ships the information content
     instead: the deduplicated row table (replicated, a few hundred KB) plus
     int16/int32 index planes (~14× smaller than the materialized stream at
-    mult=512), and gathers rows on device inside the compiled loop. Identical
+    mult=512; :class:`PackedIndexedBatches` is the ~30× form actually
+    shipped), and gathers rows on device inside the compiled loop. Identical
     stream semantics — every row still flows through the detector.
 
     ``X[s] ≡ base_X[idx[s]]``, ``y[s] ≡ base_y[idx[s]]``.
