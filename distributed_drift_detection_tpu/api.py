@@ -209,7 +209,10 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     if cfg.validate:
         from .utils.validate import validate_flag_rows
 
-        nb = (batches.idx if hasattr(batches, "idx") else batches.y).shape[1]
+        # Expected batch count from the stripe geometry — independent of the
+        # flags table, so the audit can catch a dropped/duplicated boundary.
+        per_part = -(-stream.num_rows // cfg.partitions)
+        nb = -(-per_part // cfg.per_batch)
         validate_flag_rows(flags, nb, cfg.per_batch, stream.num_rows)
 
     if cfg.results_csv:

@@ -145,9 +145,12 @@ class RunConfig:
     # are seed-equivalent but not bit-equal across different window values —
     # pin window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
     window: int = 16
-    # (A `ddm_kernel='pallas'` knob existed through round 1; the kernel lost
-    # to the XLA lowering on every measured shape and was removed — see
-    # PARITY.md "Pallas post-mortem".)
+    # (Two rejected-by-measurement alternatives are documented in PARITY.md:
+    # a `ddm_kernel='pallas'` fused kernel — ~78× slower than the XLA
+    # lowering, removed in round 2 ("Pallas post-mortem") — and a
+    # `stream_on_device` in-jit stream synthesis — TPU large-array sorts
+    # made it ~6× slower end-to-end than the packed host stripe
+    # ("Device-synthesis post-mortem").)
 
     # --- model hyper-parameters (TPU-native replacements for RandomForest) ---
     fit_steps: int = 32

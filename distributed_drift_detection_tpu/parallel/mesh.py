@@ -84,6 +84,19 @@ class MeshRunResult(NamedTuple):
     packed: jax.Array
 
 
+def finish_mesh_run(flags: FlagRows) -> MeshRunResult:
+    """The end-of-run merge shared by every runner: cross-partition drift
+    vote (lowers to an ICI all-reduce when the partition axis is
+    device-sharded — the psum merge of SURVEY §2) + the packed single-array
+    collect form."""
+    changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
+    vote = jnp.sum(changed, axis=0) / changed.shape[0]
+    packed = jnp.stack(
+        [getattr(flags, f).astype(jnp.int32) for f in FlagRows._fields]
+    )
+    return MeshRunResult(flags=flags, drift_vote=vote, packed=packed)
+
+
 _BOOL_FLAGS = frozenset({"forced_retrain"})
 
 
@@ -167,15 +180,7 @@ def make_mesh_runner(
             # int32 rows + validity mask out — engines see the exact
             # IndexedBatches the host striper would have built.
             batches = expand_packed(batches)
-        flags = vmapped(batches, keys)
-        changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
-        # Cross-partition reduction: lowers to an ICI all-reduce when the
-        # partition axis is device-sharded (the psum drift vote of SURVEY §2).
-        vote = jnp.sum(changed, axis=0) / changed.shape[0]
-        packed = jnp.stack(
-            [getattr(flags, f).astype(jnp.int32) for f in FlagRows._fields]
-        )
-        return MeshRunResult(flags=flags, drift_vote=vote, packed=packed)
+        return finish_mesh_run(vmapped(batches, keys))
 
     if mesh is None:
         return jax.jit(run)
