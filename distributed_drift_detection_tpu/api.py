@@ -209,10 +209,11 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     if cfg.validate:
         from .utils.validate import validate_flag_rows
 
+        from .io.stream import stripe_geometry
+
         # Expected batch count from the stripe geometry — independent of the
         # flags table, so the audit can catch a dropped/duplicated boundary.
-        per_part = -(-stream.num_rows // cfg.partitions)
-        nb = -(-per_part // cfg.per_batch)
+        _, nb = stripe_geometry(stream.num_rows, cfg.partitions, cfg.per_batch)
         validate_flag_rows(flags, nb, cfg.per_batch, stream.num_rows)
 
     if cfg.results_csv:

@@ -270,6 +270,17 @@ def _stripe_gmap(perms: np.ndarray) -> np.ndarray:
     return (slot * b + perms) * p + part
 
 
+def stripe_geometry(
+    num_rows: int, partitions: int, per_batch: int
+) -> tuple[int, int]:
+    """``(rows per partition, microbatches per partition)`` of the stripe —
+    ceil at both levels (partition sizes differ by ≤ 1, C8 ``:225``; the
+    last batch is padded + masked). The single source for stripers and for
+    audits that need the expected grid independent of any built table."""
+    per_part = -(-num_rows // partitions)
+    return per_part, -(-per_part // per_batch)
+
+
 def stripe_partitions(
     stream: StreamData,
     partitions: int,
@@ -283,9 +294,7 @@ def stripe_partitions(
     delay metric (global position % concept length) works per the reference's
     intent. ``shuffle_seed``: see :func:`stripe_chunk`.
     """
-    n = stream.num_rows
-    per_part = -(-n // partitions)  # ceil: partition sizes differ by ≤ 1 (C8)
-    nb = -(-per_part // per_batch)
+    _, nb = stripe_geometry(stream.num_rows, partitions, per_batch)
     return stripe_chunk(
         stream.X, stream.y, 0, partitions, per_batch, nb, shuffle_seed
     )
@@ -345,8 +354,7 @@ def stripe_partitions_packed(
         )
     n = stream.num_rows
     p, b = partitions, per_batch
-    per_part = -(-n // p)
-    nb = -(-per_part // b)
+    _, nb = stripe_geometry(n, p, b)
     if p * nb * b > 2**31 - 1:
         raise ValueError(
             f"padded stripe grid of {p * nb * b:,} positions exceeds int32 "

@@ -92,10 +92,12 @@ def validate_flag_rows(
 
     if not (wl.shape == wg.shape == cl.shape == cg.shape):
         fail("flag planes disagree on shape")
-    if wl.shape[1] > max(num_batches - 1, 0):
+    if wl.shape[1] != max(num_batches - 1, 0):
+        # Exact, both directions: a dropped boundary (too few flag rows) is
+        # as much a corruption as an extra one.
         fail(
             f"{wl.shape[1]} flag rows for {num_batches} batches "
-            "(expected at most num_batches - 1)"
+            "(expected exactly num_batches - 1)"
         )
     for name, local in (("warning_local", wl), ("change_local", cl)):
         bad = (local < -1) | (local >= per_batch)

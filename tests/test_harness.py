@@ -132,6 +132,33 @@ def test_render_all_figures(tmp_path):
     assert (tmp_path / "figs" / "delay_pct.pdf").exists()
 
 
+def test_render_all_legacy_rows_get_readable_suffix(tmp_path):
+    """Rows backfilled from pre-Model/Detector CSVs carry "-" placeholders;
+    figure filenames must map them to 'legacy', not emit 'speedup-----.pdf'
+    (round-1 advisor finding)."""
+    import csv
+
+    from distributed_drift_detection_tpu.harness.plots import render_all
+    from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
+
+    base = base_cfg(tmp_path)
+    run_grid(base, mults=[1], partitions=[1, 2], trials=1, progress=lambda *_: None)
+    with open(base.results_csv) as fh:
+        rows = list(csv.reader(fh))
+    # Modern rows + the same rows as legacy-backfilled placeholders ("-"
+    # Model/Detector) in one CSV → two combos, so figures get suffixed.
+    combined = str(tmp_path / "combined.csv")
+    with open(combined, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerows(rows)
+        for r in rows[1:]:
+            w.writerow(r[: len(RESULT_COLUMNS) - 2] + ["-", "-"])
+    artifacts = render_all(combined, str(tmp_path / "figs2"))
+    suffixed = [k for k in artifacts if "legacy" in k]
+    assert suffixed, f"no legacy-suffixed figures in {sorted(artifacts)}"
+    assert not any("---" in k for k in artifacts), sorted(artifacts)
+
+
 def test_argv_entry_point_reference_contract(tmp_path, monkeypatch, capsys):
     """python -m distributed_drift_detection_tpu URL INSTANCES MEMORY CORES
     TIME_STRING MULT_DATA [DATASET] — the reference's argv order
