@@ -23,9 +23,11 @@ can absorb tens of seconds of one-time setup (device init, remote compile
 service) that a single warm-up does not always amortise, and individual
 repetitions occasionally catch multi-second stalls of the shared tunnel
 itself. The benchmark therefore runs two warm-ups and reports the **median
-of five timed repetitions** — the closest robust analog of the reference's
-trial-mean methodology (means of ≥4 trials on a warm, dedicated cluster,
-BASELINE.md) under noisy measurement infrastructure.
+of nine timed repetitions** (each well under a second warm, so the extra
+repetitions are cheap insurance against stall-polluted medians) — the
+closest robust analog of the reference's trial-mean methodology (means of
+≥4 trials on a warm, dedicated cluster, BASELINE.md) under noisy
+measurement infrastructure.
 """
 
 import json
@@ -176,10 +178,10 @@ def main() -> None:
         jax.block_until_ready(runner(db, dk))
 
     # Timed runs — each spans the reference's Final Time
-    # (upload + detect + collect + delay metric); report the median of 5
+    # (upload + detect + collect + delay metric); report the median of 9
     # (see module docstring).
     times = []
-    for _ in range(5):
+    for _ in range(9):
         start = time.perf_counter()
         db, dk = shard_batches(batches, keys, mesh)
         out = runner(db, dk)
