@@ -115,23 +115,39 @@ def _chain_run(legs, batches_per_leg, **kw):
     )
 
 
-def test_chained_soak_matches_one_shot_bitwise():
-    """A 4-leg chained soak equals the one-shot runner bit-for-bit (modulo
-    the partition row offset: one-shot rows are global, chain rows are
-    partition-local) — the exactness contract of make_soak_chain. Geometry
-    is leg-aligned: 25 batches/leg × 100 rows = 2500 ≡ 0 mod 500, and the
-    per-partition total (100·100) is a multiple of drift_every so the
-    one-shot's global row arithmetic agrees."""
-    one = _run(num_batches=100, drift_every=500)
-    chained = _chain_run(legs=4, batches_per_leg=25, drift_every=500)
-    part_offset = (np.arange(4) * 100 * 100).astype(np.int64)[:, None]
-    for name in one.flags._fields:
-        a = np.asarray(getattr(one.flags, name))
-        b = np.asarray(getattr(chained, name))
+def _assert_chain_equals_one_shot(one_flags, chained_flags, partitions, rows_pp):
+    """Chained flags == one-shot flags, modulo the partition row offset
+    (one-shot rows are global, chain rows partition-local)."""
+    part_offset = (np.arange(partitions) * rows_pp).astype(np.int64)[:, None]
+    for name in one_flags._fields:
+        want = np.asarray(getattr(one_flags, name))
+        got = np.asarray(getattr(chained_flags, name))
         if name in ("warning_global", "change_global"):
-            # Global-position flags: add the partition offset where flagged.
-            b = np.where(b >= 0, b + part_offset, b)
-        np.testing.assert_array_equal(a, b, err_msg=name)
+            got = np.where(got >= 0, got + part_offset, got)
+        np.testing.assert_array_equal(want, got, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "p,b,legs,bpl,de",
+    [
+        (4, 100, 4, 25, 500),   # the headline-like geometry
+        (2, 50, 5, 10, 250),    # more legs, smaller batches, ragged-free
+    ],
+)
+def test_chained_soak_matches_one_shot_bitwise(p, b, legs, bpl, de):
+    """A multi-leg chained soak equals the one-shot runner bit-for-bit
+    (modulo the partition row offset: one-shot rows are global, chain rows
+    are partition-local) — the exactness contract of make_soak_chain.
+    Geometries are leg-aligned (bpl·b ≡ 0 mod drift_every) and the
+    per-partition total is a multiple of drift_every so the one-shot's
+    global row arithmetic agrees."""
+    nb = legs * bpl
+    one = _run(partitions=p, per_batch=b, num_batches=nb, drift_every=de)
+    chained = _chain_run(
+        legs=legs, batches_per_leg=bpl, partitions=p, per_batch=b,
+        drift_every=de,
+    )
+    _assert_chain_equals_one_shot(one.flags, chained, p, nb * b)
 
 
 def test_chained_soak_driver_summary():
@@ -304,3 +320,16 @@ def test_chained_soak_driver_on_mesh():
     assert sharded.legs == single.legs >= 2
     assert sharded.detections == single.detections
     np.testing.assert_array_equal(sharded.delays, single.delays)
+
+
+@pytest.mark.parametrize("det_name", ["ph", "eddm"])
+def test_chained_soak_detector_zoo_matches_one_shot(det_name):
+    """The chain's detector seam: zoo detectors flow through legs with the
+    same carried-state exactness as DDM."""
+    from distributed_drift_detection_tpu.config import PHParams
+    from distributed_drift_detection_tpu.ops.detectors import make_detector
+
+    det = make_detector(det_name, ph=PHParams(threshold=10.0))
+    one = _run(num_batches=40, detector=det)
+    chained = _chain_run(legs=4, batches_per_leg=10, detector=det)
+    _assert_chain_equals_one_shot(one.flags, chained, 4, 40 * 100)
