@@ -1,7 +1,8 @@
 from .aggregate import aggregate, load_runs, scaleup_table, speedup_table, write_tables
-from .grid import grid_configs, missing_configs, run_grid
+from .grid import grid_configs, missing_configs, off_spec_reason, run_grid
 
 __all__ = [
+    "off_spec_reason",
     "aggregate",
     "load_runs",
     "scaleup_table",

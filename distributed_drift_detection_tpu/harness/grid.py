@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import itertools
+import os
 import sys
 from collections import Counter
 
@@ -91,6 +92,41 @@ def _config_key(cfg: RunConfig) -> str:
     )
 
 
+def off_spec_reason(cfg: RunConfig) -> str | None:
+    """The notebook's per-dataset grid-validity rule (C13/C14).
+
+    ``Plot Results.ipynb`` cell 3 refuses to schedule missing trials for
+    off-spec cells: outdoorStream only at ``Data Multiplier >= 64`` and
+    ``Instances <= 16``; rialto-like streams at any ``mult >= 1``. The rule
+    was convention in the reference (hand-enforced when regenerating
+    ``missing_exps.sh``); here it is code, so an off-spec sweep is a choice
+    (``spec='off'``), not an accident. Returns a human-readable reason when
+    ``cfg`` falls outside its dataset's published grid, else ``None`` —
+    including for datasets the notebook published no grid for (a user's own
+    CSV sweeps whatever it likes, e.g. the supported ``mult_data < 1``
+    subsampling mode).
+    """
+    name = os.path.basename(str(cfg.dataset))
+    if name.startswith("outdoorStream"):
+        if cfg.mult_data < 64:
+            return (
+                f"outdoorStream grid starts at mult_data=64 (got "
+                f"{cfg.mult_data}; Plot Results.ipynb cell 3)"
+            )
+        if cfg.partitions > 16:
+            return (
+                f"outdoorStream grid caps partitions at 16 (got "
+                f"{cfg.partitions}; Plot Results.ipynb cell 3)"
+            )
+    elif name.startswith("rialto") or cfg.dataset == "synth:rialto":
+        if cfg.mult_data < 1:
+            return (
+                f"rialto grid requires mult_data >= 1 (got {cfg.mult_data}; "
+                "Plot Results.ipynb cell 3)"
+            )
+    return None
+
+
 def completed_trials(results_csv: str) -> Counter:
     """Count completed trials per config key from the results CSV (the C13
     trial count / C14 missing-trial detection, done on live data)."""
@@ -125,6 +161,7 @@ def run_grid(
     progress=print,
     detectors: list[str] | None = None,
     warmup: bool = False,
+    spec: str = "warn",
 ) -> int:
     """Run all missing trials of the sweep; returns number executed.
 
@@ -134,10 +171,33 @@ def run_grid(
     reference's warm-cluster methodology (BASELINE.md: its numbers exclude
     cluster start-up; trials are config-major, so one warm run covers the
     whole trial block).
+
+    ``spec`` applies the notebook's per-dataset grid-validity rule
+    (:func:`off_spec_reason`): ``'warn'`` (default) runs off-spec cells but
+    flags each once via ``progress``; ``'skip'`` drops them from the sweep;
+    ``'off'`` disables the check entirely.
     """
+    if spec not in ("warn", "skip", "off"):
+        raise ValueError(f"spec must be 'warn', 'skip' or 'off', got {spec!r}")
+
     from ..api import run  # lazy: keeps harness importable without jax init
 
     configs = grid_configs(base, mults, partitions, models, trials, detectors)
+    if spec != "off":
+        flagged: set[str] = set()
+        kept = []
+        for cfg in configs:
+            reason = off_spec_reason(cfg)
+            if reason is None:
+                kept.append(cfg)
+                continue
+            if reason not in flagged:
+                flagged.add(reason)
+                verb = "skipping" if spec == "skip" else "off-spec"
+                progress(f"grid {verb}: {reason}")
+            if spec == "warn":
+                kept.append(cfg)
+        configs = kept
     todo = missing_configs(configs)
     progress(f"grid: {len(configs)} trials total, {len(todo)} to run")
     warmed = None
@@ -174,6 +234,14 @@ def main(argv=None) -> None:
         help="one unrecorded warm run before each config's timed trials "
         "(warm-only Final Times; see run_grid)",
     )
+    ap.add_argument(
+        "--spec",
+        default="warn",
+        choices=["warn", "skip", "off"],
+        help="notebook grid-validity rule (off_spec_reason): warn on "
+        "off-spec (dataset, mult, partitions) cells, skip them, or disable "
+        "the check",
+    )
     args = ap.parse_args(argv)
 
     base = RunConfig(
@@ -189,6 +257,7 @@ def main(argv=None) -> None:
         trials=args.trials,
         detectors=args.detectors.split(","),
         warmup=args.warmup,
+        spec=args.spec,
     )
 
 

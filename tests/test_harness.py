@@ -50,6 +50,63 @@ def test_grid_idempotent_resume(tmp_path):
     assert n3 == 1
 
 
+def test_grid_spec_rule_warns_and_skips(tmp_path):
+    """The notebook's per-dataset validity rule (Plot Results.ipynb cell 3)
+    is code, not convention: off-spec (dataset, mult, partitions) cells warn
+    by default, are dropped with spec='skip', and run silently with
+    spec='off'."""
+    import pytest
+
+    from distributed_drift_detection_tpu.harness import off_spec_reason
+
+    base = base_cfg(tmp_path)
+    # outdoorStream: mult < 64 and partitions > 16 are off-spec; rialto-like
+    # streams only reject mult < 1.
+    assert off_spec_reason(RunConfig(dataset=OUTDOOR, mult_data=1)) is not None
+    assert off_spec_reason(
+        RunConfig(dataset=OUTDOOR, mult_data=64, partitions=32)
+    ) is not None
+    assert off_spec_reason(
+        RunConfig(dataset=OUTDOOR, mult_data=64, partitions=16)
+    ) is None
+    assert off_spec_reason(RunConfig(dataset="synth:rialto", mult_data=0.5))
+    assert off_spec_reason(RunConfig(dataset="synth:rialto", mult_data=1)) is None
+    # Datasets the notebook published no grid for are never flagged — a
+    # user's own CSV may use the supported mult_data < 1 subsampling mode.
+    assert off_spec_reason(
+        RunConfig(dataset="/data/myown.csv", mult_data=0.5, partitions=99)
+    ) is None
+
+    # spec='warn' (default): off-spec trials still run, each rule flagged
+    # once through `progress`.
+    msgs = []
+    n = run_grid(base, mults=[1], partitions=[1], trials=1, progress=msgs.append)
+    assert n == 1
+    warned = [m for m in msgs if "off-spec" in m]
+    assert len(warned) == 1 and "mult_data=64" in warned[0]
+
+    # spec='skip': the off-spec cell is dropped from the sweep entirely.
+    base2 = RunConfig(
+        dataset=OUTDOOR, per_batch=50, model="majority",
+        results_csv=str(tmp_path / "runs2.csv"),
+    )
+    msgs2 = []
+    n = run_grid(base2, mults=[1], partitions=[1], trials=1,
+                 spec="skip", progress=msgs2.append)
+    assert n == 0
+    assert any("skipping" in m for m in msgs2)
+
+    # spec='off': no check at all.
+    msgs3 = []
+    n = run_grid(base2, mults=[1], partitions=[1], trials=1,
+                 spec="off", progress=msgs3.append)
+    assert n == 1
+    assert not any("spec" in m for m in msgs3)
+
+    with pytest.raises(ValueError, match="spec"):
+        run_grid(base2, mults=[1], partitions=[1], trials=1, spec="bogus")
+
+
 def test_append_projects_rows_onto_legacy_header(tmp_path):
     """Appending to a results CSV written under an older (shorter) schema
     must project rows onto the file's own header — never ragged lines."""
