@@ -28,7 +28,13 @@ from typing import NamedTuple
 import jax
 import numpy as np
 
-from .config import RunConfig, auto_window, host_shuffle_seed, replace
+from .config import (
+    RunConfig,
+    auto_ph_threshold,
+    auto_window,
+    host_shuffle_seed,
+    replace,
+)
 from .engine.loop import FlagRows
 from .io.stream import (
     StreamData,
@@ -121,8 +127,16 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # geometry planes are synthesized in-jit) — identical flags, ~30× less
     # transfer than the materialized stream at mult=512 (~2.3× less than
     # the round-1 indexed form).
-    # window == 0 → auto-size from the stream's planted drift spacing.
+    # window == 0 → auto-size from the stream's planted drift spacing;
+    # ph.threshold == 0 → auto-tune λ from the same geometry.
     cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
+    if cfg.detector == "ph":  # auto_ph_threshold passes an explicit λ through
+        cfg = replace(
+            cfg,
+            ph=cfg.ph._replace(
+                threshold=auto_ph_threshold(cfg, stream.dist_between_changes)
+            ),
+        )
     indexed = stream.src is not None and cfg.window > 1
     striper = stripe_partitions_packed if indexed else stripe_partitions
     batches = striper(

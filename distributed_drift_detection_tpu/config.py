@@ -63,13 +63,19 @@ class PHParams(NamedTuple):
     small relative to the per-partition concept length (λ=50 on 100-element
     concepts detects late or never — the same sensitivity story as the
     reference cranking DDM's defaults 30/2/3 down to 3/0.5/1.5,
-    ``DDM_Process.py:27-29``). λ≈10 matches the reference's planted-drift
-    benchmark geometry at 8 partitions.
+    ``DDM_Process.py:27-29``).
+
+    ``threshold = 0`` (the default) means **auto**: ``api.prepare`` resolves
+    λ from the stream's planted-drift geometry via
+    :func:`auto_ph_threshold` — the same pattern as ``window = 0`` →
+    :func:`auto_window` — so ``RunConfig(detector='ph')`` detects out of the
+    box at any benchmark geometry. Pass an explicit λ (e.g. the classic 50)
+    to pin it; detector kernels refuse an unresolved 0.
     """
 
     min_num_instances: int = 30
     delta: float = 0.005
-    threshold: float = 50.0
+    threshold: float = 0.0  # 0 = auto (config.auto_ph_threshold)
     alpha: float = 1.0
     warning_fraction: float = 0.5
 
@@ -209,6 +215,30 @@ def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:
 
     w = 1 << (round(math.log2(bpc)) if bpc > 1 else 0)
     return int(min(64, max(4, w)))
+
+
+def auto_ph_threshold(cfg: RunConfig, dist_between_changes: int) -> float:
+    """Resolve ``PHParams.threshold == 0`` (auto) from stream geometry.
+
+    λ is Page–Hinkley's cumulative excess-error budget in *elements*: after
+    a drift the statistic grows by ≈ (1 − x̄ − δ) per element, so detection
+    delay is ≈ λ elements while noise immunity grows with λ. Scale it to the
+    per-partition concept length (``dist_between_changes / partitions`` —
+    each partition sees a 1/P stripe of every planted concept): λ =
+    ``concept_pp / 16``, clamped to [4, 32]. The floor keeps the statistic
+    above single-element noise at tiny test geometries; the cap bounds
+    detection delay to well under one worker-batch at benchmark geometries
+    (measured: λ ∈ [8, 32] detects every planted outdoorStream boundary,
+    delay-minimal around λ ≈ 16, while the classic λ = 50 on a 128-element
+    concept eats half the concept in delay). Streams with no planted-drift
+    geometry (``dist_between_changes <= 0``) fall back to the classic 50.
+    """
+    if cfg.ph.threshold:
+        return cfg.ph.threshold
+    if dist_between_changes <= 0:
+        return 50.0
+    concept_pp = dist_between_changes / max(cfg.partitions, 1)
+    return float(min(32.0, max(4.0, concept_pp / 16.0)))
 
 
 def host_shuffle_seed(cfg: RunConfig) -> int | None:

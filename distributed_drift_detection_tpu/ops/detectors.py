@@ -123,10 +123,39 @@ def ph_init() -> PHState:
     return PHState(jnp.int32(0), jnp.float32(0.0), jnp.float32(0.0))
 
 
+def _validate_ph(params: PHParams) -> None:
+    """Reject out-of-range concrete PH params at every public kernel entry
+    (scalar step, batch and window passes) so no path can silently diverge
+    from the others. Only a tracer (params passed as a jit argument,
+    ``float()`` unavailable) is waved through — there the registry/engine
+    path has already checked. The (A, B, K)-triple compose assumes
+    ``alpha ≥ 0`` (max doesn't distribute over multiplication by a
+    negative); ``threshold = 0`` is the unresolved auto sentinel
+    (``config.auto_ph_threshold``) and would fire on every excess-error
+    element."""
+    try:
+        alpha = float(params.alpha)
+    except TypeError:  # jax ConcretizationTypeError is a TypeError
+        alpha = None
+    if alpha is not None and not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"PHParams.alpha must be in [0, 1], got {alpha}")
+    try:
+        thr = float(params.threshold)
+    except TypeError:
+        thr = None
+    if thr is not None and thr <= 0.0:
+        raise ValueError(
+            f"PHParams.threshold must be > 0, got {thr} (0 = auto, resolved "
+            "from stream geometry by api.prepare / config.auto_ph_threshold "
+            "— pass a resolved λ to the kernels)"
+        )
+
+
 def ph_step(
     state: PHState, err: jax.Array, params: PHParams = PHParams()
 ) -> tuple[PHState, tuple[jax.Array, jax.Array]]:
     """One element (executable spec — see module docstring)."""
+    _validate_ph(params)
     cnt = state.count + 1
     xsum = state.x_sum + err
     mean = xsum / cnt.astype(jnp.float32)
@@ -139,19 +168,7 @@ def ph_step(
 
 def _ph_masks(state: PHState, errs: jax.Array, valid: jax.Array, params: PHParams):
     """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``."""
-    # The (A, B, K)-triple compose below assumes A ≥ 0 (max doesn't
-    # distribute over multiplication by a negative); enforce at every entry
-    # to the vectorised path, not just the make_detector registry, so
-    # ph_batch/ph_window can never silently diverge from ph_step. Any
-    # concrete alpha (Python, NumPy or JAX scalar) is validated; only a
-    # tracer (params passed as a jit argument, float() unavailable) is
-    # waved through — there the registry/engine path has already checked.
-    try:
-        alpha = float(params.alpha)
-    except TypeError:  # jax ConcretizationTypeError is a TypeError
-        alpha = None
-    if alpha is not None and not 0.0 <= alpha <= 1.0:
-        raise ValueError(f"PHParams.alpha must be in [0, 1], got {alpha}")
+    _validate_ph(params)
     v = valid.astype(jnp.int32)
     cnt = state.count + jnp.cumsum(v)
     xsum = state.x_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
@@ -371,6 +388,12 @@ def make_detector(
         if not 0.0 <= ph.alpha <= 1.0:
             raise ValueError(
                 f"PHParams.alpha must be in [0, 1], got {ph.alpha}"
+            )
+        if ph.threshold <= 0.0:
+            raise ValueError(
+                f"PHParams.threshold must be > 0, got {ph.threshold} "
+                "(0 = auto: let api.prepare resolve it via "
+                "config.auto_ph_threshold, or pass an explicit λ)"
             )
         return DetectorKernel(
             "ph",

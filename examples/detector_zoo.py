@@ -14,7 +14,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
 
-from distributed_drift_detection_tpu import PHParams, RunConfig, run
+from distributed_drift_detection_tpu import RunConfig, run
 from distributed_drift_detection_tpu.config import replace
 
 
@@ -26,9 +26,9 @@ def main():
         per_batch=50,
         model="centroid",
         results_csv="",
-        # PH's λ is a cumulative excess-error budget — size it below the
-        # per-partition concept length (see config.PHParams docstring).
-        ph=PHParams(threshold=10.0),
+        # PH's λ (a cumulative excess-error budget) auto-tunes from the
+        # stream's planted-drift geometry by default — PHParams.threshold = 0
+        # → config.auto_ph_threshold; pass PHParams(threshold=...) to pin it.
     )
     print(f"{'detector':<10} {'detections':>10} {'mean delay (rows)':>18} "
           f"{'Final Time (s)':>15}")

@@ -263,6 +263,56 @@ def test_ph_rejects_alpha_out_of_range():
         ph_window(ph_init(), e.reshape(2, 4), v.reshape(2, 4), PHParams(alpha=1.5))
 
 
+def test_ph_threshold_zero_means_auto():
+    """PHParams.threshold = 0 (the default) is 'auto': kernels refuse it
+    unresolved, config.auto_ph_threshold resolves it from stream geometry,
+    and api.prepare applies the resolution (the config.auto_window pattern)."""
+    from distributed_drift_detection_tpu.api import prepare
+    from distributed_drift_detection_tpu.config import auto_ph_threshold
+
+    assert PHParams().threshold == 0.0
+    with pytest.raises(ValueError, match="threshold"):
+        make_detector("ph")  # default params are unresolved
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="threshold"):
+        ph_batch(ph_init(), e, v, PHParams())
+    with pytest.raises(ValueError, match="threshold"):
+        ph_step(ph_init(), jnp.float32(1.0), PHParams())
+
+    # Formula: concept_pp / 16 clamped to [4, 32]; explicit λ passes through;
+    # no planted geometry falls back to the classic 50.
+    cfg = RunConfig(partitions=16)
+    assert auto_ph_threshold(cfg, 2048) == 8.0
+    assert auto_ph_threshold(cfg, 100) == 4.0  # floor
+    assert auto_ph_threshold(cfg, 1 << 20) == 32.0  # cap
+    assert auto_ph_threshold(RunConfig(ph=PHParams(threshold=50.0)), 2048) == 50.0
+    assert auto_ph_threshold(cfg, 0) == 50.0
+
+    # api.prepare resolves it: outdoorStream mult=8 → dist 800, p=2 →
+    # concept_pp 400 → λ = 25.
+    prep = prepare(
+        RunConfig(
+            dataset="/root/reference/outdoorStream.csv",
+            mult_data=8.0,
+            partitions=2,
+            detector="ph",
+            results_csv="",
+        )
+    )
+    assert prep.config.ph.threshold == 25.0
+    # Non-ph configs keep the sentinel untouched (nothing resolves it).
+    prep_ddm = prepare(
+        RunConfig(
+            dataset="/root/reference/outdoorStream.csv",
+            mult_data=8.0,
+            partitions=2,
+            results_csv="",
+        )
+    )
+    assert prep_ddm.config.ph.threshold == 0.0
+
+
 # --------------------------------------------------------------------------
 # engine / api integration
 # --------------------------------------------------------------------------
