@@ -44,6 +44,91 @@ def delay_metrics(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class AttributionMetrics:
+    """Detections attributed to planted concept boundaries (quality axis).
+
+    The reference's merge contract is about *which* changes are found —
+    "every device will find the same changes" (``DDM_Process.py:89-92``) —
+    so a delay number alone under-constrains quality: a model that fires
+    more often can look "earlier" on mean delay while actually spraying
+    extra detections. Attribution closes that loophole: each detection at
+    global position ``g`` attributes to the most recent planted boundary
+    (``g // dist``, boundaries at ``m·dist`` for ``m ≥ 1``); per
+    (partition, boundary) the earliest attributed detection is the *first
+    hit* (its delay is ``g % dist``), later ones and any detection before
+    the first boundary are *spurious*. This generalises the soak's exact
+    accounting (``engine.soak.planted_interior_boundaries``) to the striped
+    api streams, where every partition sees every global boundary.
+
+    ``precision`` = first hits / all detections; ``recall`` = hit
+    (partition, boundary) pairs / (partitions × boundaries).
+    """
+
+    num_boundaries: int  # interior planted boundaries in the global stream
+    hits: int  # (partition, boundary) pairs with >= 1 attributed detection
+    misses: int  # partitions * num_boundaries - hits
+    spurious: int  # non-first attributed + pre-first-boundary detections
+    precision: float  # hits / num_detections (nan when no detections)
+    recall: float  # hits / (partitions * num_boundaries)
+    mean_first_hit_delay_rows: float  # over hit pairs only (nan when none)
+    first_hit_delays: np.ndarray  # [hits] i64, rows past the boundary
+
+
+def attribution_metrics(
+    change_global: np.ndarray, dist_between_changes: int, num_rows: int
+) -> AttributionMetrics:
+    """Attribute a ``[P, NB-1]`` change-position table to planted boundaries.
+
+    ``dist_between_changes`` is the planted concept length of the *global*
+    stream (``StreamData.dist_between_changes``); boundaries sit at
+    ``m·dist`` for ``1 ≤ m ≤ (num_rows − 1) // dist``. Positions are global
+    row ids, so the same boundary set applies to every partition's stripe.
+    """
+    change_global = np.asarray(change_global)
+    p = change_global.shape[0]
+    dist = int(dist_between_changes)
+    nb = (int(num_rows) - 1) // dist if dist > 0 else 0
+    detected = change_global >= 0
+    num_detections = int(detected.sum())
+    if nb <= 0 or num_detections == 0:
+        return AttributionMetrics(
+            num_boundaries=nb,
+            hits=0,
+            misses=p * nb,
+            spurious=num_detections,
+            precision=float("nan") if num_detections == 0 else 0.0,
+            recall=0.0 if nb else float("nan"),
+            mean_first_hit_delay_rows=float("nan"),
+            first_hit_delays=np.empty(0, np.int64),
+        )
+
+    part, _ = np.nonzero(detected)
+    pos = change_global[detected].astype(np.int64)
+    boundary = pos // dist  # 0 = before the first boundary -> spurious
+    in_range = (boundary >= 1) & (boundary <= nb)
+    # First (earliest-by-position) detection per (partition, boundary):
+    # sort by position, then np.unique's first occurrence per pair is the
+    # earliest (flag tables are batch-ordered and already ascending, but
+    # position order is the contract, not column order).
+    pb = part[in_range] * np.int64(nb + 1) + boundary[in_range]
+    pos_ir = pos[in_range]
+    order = np.argsort(pos_ir, kind="stable")
+    _, first_idx = np.unique(pb[order], return_index=True)
+    hits = int(first_idx.size)
+    delays = (pos_ir[order][first_idx] % dist).astype(np.int64)
+    return AttributionMetrics(
+        num_boundaries=nb,
+        hits=hits,
+        misses=p * nb - hits,
+        spurious=num_detections - hits,
+        precision=hits / num_detections,
+        recall=hits / (p * nb),
+        mean_first_hit_delay_rows=float(delays.mean()),
+        first_hit_delays=delays,
+    )
+
+
 # Reference C11 column schema (``DDM_Process.py:272``), kept verbatim so the
 # notebook-style aggregation (C13-C15) ports unchanged; extended with
 # throughput columns. "Spark Address" carries the backend string here.

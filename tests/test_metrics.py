@@ -1,0 +1,87 @@
+"""Boundary-attribution metrics (the detection-quality axis).
+
+``metrics.attribution_metrics`` decomposes a change-position table into
+first hits on planted boundaries vs spurious extra fires — the accounting
+behind the delay-parity artifact's precision/recall columns and the
+spurious-rate acceptance criterion (harness/parity.py).
+"""
+
+import numpy as np
+
+from distributed_drift_detection_tpu.metrics import (
+    attribution_metrics,
+    delay_metrics,
+)
+
+
+def test_attribution_hand_built_table():
+    # Global stream: 400 rows, dist=100 -> boundaries at 100, 200, 300
+    # (nb=3), 2 partitions.
+    table = np.array(
+        [
+            # p0: first hit on b1 (105), duplicate on b1 (190, spurious),
+            # first hit on b3 (301); b2 missed.
+            [105, 190, 301, -1],
+            # p1: pre-first-boundary fire (50, spurious), first hits on b2
+            # (210) and b3 (399); b1 missed.
+            [50, 210, 399, -1],
+        ],
+        dtype=np.int64,
+    )
+    a = attribution_metrics(table, 100, 400)
+    assert a.num_boundaries == 3
+    assert a.hits == 4
+    assert a.misses == 2 * 3 - 4
+    assert a.spurious == 2
+    assert a.precision == 4 / 6
+    assert a.recall == 4 / 6
+    np.testing.assert_array_equal(np.sort(a.first_hit_delays), [1, 5, 10, 99])
+    assert a.mean_first_hit_delay_rows == (5 + 1 + 10 + 99) / 4
+
+
+def test_attribution_first_hit_is_earliest_per_pair():
+    # Two detections attributed to the same boundary: the earlier one is the
+    # hit, the later one spurious — per partition independently.
+    table = np.array([[110, 150, -1], [130, 120, -1]], dtype=np.int64)
+    # p1's positions ascend batch-wise in real tables; here 130 precedes 120
+    # columnwise, but position order (not column order) must win for delay.
+    a = attribution_metrics(table, 100, 200)
+    assert a.num_boundaries == 1
+    assert a.hits == 2 and a.spurious == 2
+    assert sorted(a.first_hit_delays.tolist()) == [10, 20]
+
+
+def test_attribution_empty_and_no_geometry():
+    empty = np.full((3, 5), -1, np.int64)
+    a = attribution_metrics(empty, 100, 400)
+    assert a.hits == 0 and a.spurious == 0 and a.misses == 9
+    assert np.isnan(a.precision) and a.recall == 0.0
+    assert np.isnan(a.mean_first_hit_delay_rows)
+
+    # No planted geometry (dist <= 0 or single concept): everything counts
+    # as spurious, recall undefined.
+    one = np.array([[42, -1]], np.int64)
+    a = attribution_metrics(one, 0, 100)
+    assert a.num_boundaries == 0 and a.spurious == 1
+    assert np.isnan(a.recall)
+    a = attribution_metrics(one, 100, 100)  # rows fit one concept -> nb=0
+    assert a.num_boundaries == 0 and a.spurious == 1 and a.precision == 0.0
+
+
+def test_attribution_agrees_with_delay_metrics_on_clean_table():
+    # When every detection is a unique first hit, the attribution delays are
+    # exactly delay_metrics' per-detection delays.
+    rng = np.random.default_rng(0)
+    p, nb, dist = 4, 5, 1000
+    table = np.full((p, 8), -1, np.int64)
+    for q in range(p):
+        for m in range(1, nb + 1):
+            table[q, m - 1] = m * dist + int(rng.integers(0, dist))
+    d = delay_metrics(table, dist, 100)
+    a = attribution_metrics(table, dist, (nb + 1) * dist)
+    assert a.hits == d.num_detections == p * nb
+    assert a.spurious == 0 and a.recall == 1.0 and a.precision == 1.0
+    np.testing.assert_array_equal(
+        np.sort(a.first_hit_delays), np.sort(d.delays)
+    )
+    assert np.isclose(a.mean_first_hit_delay_rows, d.mean_delay_rows)

@@ -4,20 +4,26 @@ Makes the PARITY.md rf-vs-flagship table checkable by pytest + one command
 (``python -m distributed_drift_detection_tpu.harness.parity`` regenerates
 the committed ``results/delay_parity.csv``). The live test here runs the
 same measurement at CI size: fewer seeds and a smaller forest, same stream
-family and criterion.
+family and criteria — the one-sided delay bound AND the spurious-rate
+bound (boundary attribution closes the fire-more-often loophole).
 """
 
 import numpy as np
+import pytest
 
 from distributed_drift_detection_tpu.harness.parity import (
+    SPURIOUS_TOLERANCE,
     check_criterion,
+    check_spurious,
     measure_delay_parity,
     summarize,
     write_csv,
 )
 
 
-def _rows(model, delays, detections=100, partitions=8):
+def _rows(model, delays, detections=100, partitions=8, hits=None, spurious=None):
+    hits = detections if hits is None else hits
+    spurious = detections - hits if spurious is None else spurious
     return [
         {
             "model": model,
@@ -25,6 +31,12 @@ def _rows(model, delays, detections=100, partitions=8):
             "mean_delay_batches": d,
             "mean_delay_rows": d * 100,
             "detections": detections,
+            "hits": hits,
+            "misses": 0,
+            "spurious": spurious,
+            "precision": hits / max(hits + spurious, 1),
+            "recall": 1.0,
+            "first_hit_delay_batches": d,
             "partitions": partitions,
             "per_batch": 100,
             "mult_data": 4.0,
@@ -48,10 +60,50 @@ def test_summarize_and_criterion_units():
     assert gaps["centroid"] <= 8 and not gaps["slowpoke"] <= 8
 
 
-def test_flagship_meets_parity_criterion_vs_rf(tmp_path):
+def test_spurious_criterion_catches_overfiring():
+    """A model that buys a better mean delay by firing more often passes the
+    delay bound but fails the spurious-rate bound."""
+    rows = (
+        _rows("rf", [50.0], hits=96, spurious=4)  # 4% spurious
+        + _rows("sprayer", [30.0], detections=140, hits=96, spurious=44)
+        + _rows("clean", [45.0], hits=100, spurious=0)
+    )
+    gaps = check_criterion(rows)
+    assert gaps["sprayer"] <= 8  # "earlier" on mean delay...
+    spur = check_spurious(rows)
+    # ...but 44/140 ≈ 0.314 spurious vs rf's 0.04 → +0.274 inflation.
+    assert spur["sprayer"] > SPURIOUS_TOLERANCE
+    assert spur["clean"] <= 0.0  # cleaner than the baseline is fine
+    # summaries carry the attribution means
+    s = {x.model: x for x in summarize(rows)}
+    assert s["sprayer"].spurious == 44.0 and s["rf"].hits == 96.0
+
+
+def test_summarize_tolerates_legacy_rows_without_attribution():
+    """Rows from a pre-attribution CSV still summarize (nan attribution)."""
+    legacy = [
+        {
+            "model": "rf",
+            "seed": 0,
+            "mean_delay_batches": 50.0,
+            "mean_delay_rows": 5000.0,
+            "detections": 100,
+            "partitions": 8,
+            "per_batch": 100,
+            "mult_data": 4.0,
+            "dataset": "synth:rialto",
+        }
+    ]
+    s = summarize(legacy)[0]
+    assert s.mean == 50.0 and np.isnan(s.hits) and np.isnan(s.first_hit_delay)
+
+
+@pytest.mark.slow
+def test_flagship_meets_parity_criteria_vs_rf(tmp_path):
     """Live CI-sized measurement: the flagship detects no more than one
     worker-batch later than the reference's RandomForest family on the
-    rialto stand-in (it actually detects earlier — PARITY.md)."""
+    rialto stand-in (it actually detects earlier — PARITY.md), and does not
+    buy that delay with spurious fires beyond the tolerance."""
     partitions = 8
     rows = measure_delay_parity(
         models=("rf", "centroid"),
@@ -65,10 +117,18 @@ def test_flagship_meets_parity_criterion_vs_rf(tmp_path):
         assert len(rs) == 2
         assert all(np.isfinite(r["mean_delay_batches"]) for r in rs), m
         assert all(r["detections"] > 0 for r in rs), m
+        # attribution invariants: detections decompose exactly; recall>0
+        assert all(r["hits"] + r["spurious"] == r["detections"] for r in rs), m
+        assert all(r["recall"] > 0 for r in rs), m
     gap = check_criterion(rows)["centroid"]
     assert gap <= partitions, (
         f"flagship detects {gap:.1f} global batches later than rf — "
         f"beyond one worker-batch ({partitions})"
+    )
+    inflation = check_spurious(rows)["centroid"]
+    assert inflation <= SPURIOUS_TOLERANCE, (
+        f"flagship spends {inflation:+.3f} more of its detections on "
+        f"spurious fires than rf (tolerance {SPURIOUS_TOLERANCE})"
     )
     # Round-trip the artifact writer on the measured rows.
     out = tmp_path / "delay_parity.csv"
