@@ -545,6 +545,19 @@ def _materialize_like(sds):
     return jnp.zeros(sds.shape, sds.dtype)
 
 
+def _key_fingerprint(key: jax.Array) -> str:
+    """Stable hex fingerprint of a typed PRNG key (checkpoint geometry
+    field): same key data → same string across processes and rounds."""
+    import hashlib
+
+    import numpy as np
+
+    data = np.asarray(jax.random.key_data(key))
+    return hashlib.sha256(
+        data.tobytes() + str(data.shape).encode()
+    ).hexdigest()[:16]
+
+
 def planted_interior_boundaries(
     partitions: int, rows_per_partition: int, drift_every: int
 ) -> int:
@@ -562,12 +575,16 @@ def planted_interior_boundaries(
 
 
 class ChainedSoakSummary(NamedTuple):
-    rows_processed: int  # p · legs · batches_per_leg · per_batch
+    rows_processed: int  # p · legs · batches_per_leg · per_batch (executed)
     legs: int
     detections: int
     delays: "object"  # np.ndarray i64: position % drift_every per detection
     planted_boundaries: int  # detectable (strictly-interior) boundaries
     exec_time_s: float  # execution span only (legs AOT-compiled before it)
+    # The caller's total_rows before rounding up to whole aligned legs;
+    # rows_processed >= requested_rows, and throughput is computed over the
+    # executed count (ADVICE r2: surface the distinction, don't hide it).
+    requested_rows: int = 0
 
 
 def run_soak_chained(
@@ -664,6 +681,11 @@ def run_soak_chained(
         # chain that its detector thresholds changed between runs.
         "detector": det.name,
         "detector_params": [float(v) for v in det.params],
+        # PRNG key fingerprint (ADVICE r2): a stale checkpoint at the same
+        # path must not silently continue a *different* seed's stream —
+        # resuming replays the checkpointed carry, so without this a caller
+        # passing a new `key` would get old-seed results with no warning.
+        "key_fp": _key_fingerprint(key),
     }
     detections, delays, start_leg, state = 0, [], 0, None
     if checkpoint_path and os.path.exists(checkpoint_path):
@@ -728,4 +750,5 @@ def run_soak_chained(
         ),
         planted_boundaries=planted_interior_boundaries(p, t_pp, de),
         exec_time_s=exec_time,
+        requested_rows=int(total_rows),
     )

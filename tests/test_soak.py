@@ -31,6 +31,7 @@ def test_prototypes_soak_locks_to_planted_boundaries():
     assert out.rows_processed == 4 * 100 * 100
 
 
+@pytest.mark.slow
 def test_soak_is_deterministic():
     a = _run()
     b = _run()
@@ -40,7 +41,11 @@ def test_soak_is_deterministic():
 
 @pytest.mark.parametrize(
     "generator,f",
-    [("sea", 3), ("hyperplane", 10), ("hyperplane_gradual", 10)],
+    [
+        ("sea", 3),  # fast-tier representative of the generator zoo
+        pytest.param("hyperplane", 10, marks=pytest.mark.slow),
+        pytest.param("hyperplane_gradual", 10, marks=pytest.mark.slow),
+    ],
 )
 def test_other_generators_execute(generator, f):
     """SEA/hyperplane have irreducible in-concept error, under which the
@@ -59,6 +64,7 @@ def test_unknown_generator_rejected():
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("window,chunk_batches", [(8, 11), (16, 0)])
 def test_window_soak_matches_sequential(window, chunk_batches):
     """The windowed soak (speculative span over device-generated chunks) is
@@ -75,6 +81,7 @@ def test_window_soak_matches_sequential(window, chunk_batches):
     assert win.rows_processed == seq.rows_processed
 
 
+@pytest.mark.slow
 def test_soak_mesh_sharded_matches_single_device():
     from distributed_drift_detection_tpu.parallel.mesh import make_mesh
 
@@ -134,6 +141,7 @@ def _assert_chain_equals_one_shot(one_flags, chained_flags, partitions, rows_pp)
         (2, 50, 5, 10, 250),    # more legs, smaller batches, ragged-free
     ],
 )
+@pytest.mark.slow
 def test_chained_soak_matches_one_shot_bitwise(p, b, legs, bpl, de):
     """A multi-leg chained soak equals the one-shot runner bit-for-bit
     (modulo the partition row offset: one-shot rows are global, chain rows
@@ -150,6 +158,7 @@ def test_chained_soak_matches_one_shot_bitwise(p, b, legs, bpl, de):
     _assert_chain_equals_one_shot(one.flags, chained, p, nb * b)
 
 
+@pytest.mark.slow
 def test_chained_soak_driver_summary():
     from distributed_drift_detection_tpu.engine.soak import run_soak_chained
 
@@ -185,6 +194,7 @@ def test_one_shot_ceiling_points_to_chain():
         )
 
 
+@pytest.mark.slow
 def test_chained_soak_checkpoint_resume(tmp_path):
     """A chain killed mid-run resumes from its checkpoint and returns the
     same detections/delays an uninterrupted run produces."""
@@ -234,6 +244,7 @@ def test_chained_soak_checkpoint_resume(tmp_path):
     assert not os.path.exists(ckpt)  # removed on success
 
 
+@pytest.mark.slow
 def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
     from distributed_drift_detection_tpu.engine.soak import run_soak_chained
 
@@ -268,8 +279,26 @@ def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
             partitions=4, per_batch=100, total_rows=40_000,
             drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
         )
+    # A different PRNG key is a geometry mismatch too (ADVICE r2): resuming
+    # replays the checkpointed carry, so a stale checkpoint must not
+    # silently continue the original seed's stream.
+    with pytest.raises(ValueError, match="different[\\s\\S]*geometry"):
+        run_soak_chained(
+            model, partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
+            key=jax.random.key(99),
+        )
+    # The matching key (the default key(0)) still resumes fine.
+    resumed = run_soak_chained(
+        model, partitions=4, per_batch=100, total_rows=40_000,
+        drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
+    )
+    assert resumed.legs >= 2
+    assert resumed.requested_rows == 40_000
+    assert resumed.rows_processed >= resumed.requested_rows
 
 
+@pytest.mark.slow
 def test_chained_soak_mesh_sharded_matches_single_device():
     """The chain takes a mesh like every other engine: sharded legs produce
     the same flags, and the carried state stays partition-sharded between
@@ -303,6 +332,7 @@ def test_chained_soak_mesh_sharded_matches_single_device():
     assert len(out.flags.change_global.sharding.device_set) == 8
 
 
+@pytest.mark.slow
 def test_chained_soak_driver_on_mesh():
     from distributed_drift_detection_tpu.engine.soak import run_soak_chained
     from distributed_drift_detection_tpu.parallel.mesh import make_mesh
@@ -322,6 +352,7 @@ def test_chained_soak_driver_on_mesh():
     np.testing.assert_array_equal(sharded.delays, single.delays)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("det_name", ["ph", "eddm"])
 def test_chained_soak_detector_zoo_matches_one_shot(det_name):
     """The chain's detector seam: zoo detectors flow through legs with the
