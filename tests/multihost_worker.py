@@ -10,7 +10,20 @@ same stream computed independently inside this process (the reference's
 multi-node Spark claim, ``DDM_Process.py:61-72``: more executors, same
 answer).
 
-argv: ``coordinator_address num_processes process_id``.
+Two data planes, selected by argv:
+
+* ``plain`` — dense :class:`Batches` through the sequential-ish window=4
+  engine (every plane partition-sharded).
+* ``packed`` — the *shipped flagship transport*: a compressed stream's
+  :class:`PackedIndexedBatches` (replicated row table + per-host idx/perm
+  index planes, geometry synthesized in-jit) through the ``window=64``
+  speculative engine — the exact configuration ``bench.py`` measures,
+  proven here with per-host stripes and a cross-process mesh rather than
+  only single-process (round-2 verdict: replicating the row table per host
+  and rebuilding global shape from per-host index planes is precisely the
+  kind of code that works single-process and fails on a pod).
+
+argv: ``coordinator_address num_processes process_id [plain|packed]``.
 """
 
 import sys
@@ -24,15 +37,49 @@ PARTITIONS = 8
 PER_BATCH = 8
 
 
-def main(coord: str, nproc: int, pid: int) -> None:
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", DEVICES_PER_PROC)
-
-    from distributed_drift_detection_tpu.config import DDMParams
+def _plain_stream(c: int, f: int):
+    """Dense stream + stripe: every plane partition-sharded."""
     from distributed_drift_detection_tpu.io.stream import (
         StreamData,
         stripe_partitions,
     )
+
+    rng = np.random.default_rng(0)
+    n = PARTITIONS * 16 * PER_BATCH
+    y = (np.arange(n) * c // n).astype(np.int32)
+    means = rng.normal(scale=4.0, size=(c, f)).astype(np.float32)
+    X = means[y] + rng.normal(scale=1.0, size=(n, f)).astype(np.float32)
+    stream = StreamData(X, y, num_classes=c, dist_between_changes=n // c)
+    return stripe_partitions(stream, PARTITIONS, PER_BATCH), 4, False
+
+
+def _packed_stream(c: int, f: int):
+    """Compressed stream + packed stripe: replicated row table, sharded
+    idx/perm index planes — the bench.py flagship transport."""
+    from distributed_drift_detection_tpu.io.stream import (
+        stripe_partitions_packed,
+        synthesize_stream,
+    )
+
+    rng = np.random.default_rng(0)
+    n0, mult = 256, 8  # 2048 rows, 4 concepts of 512
+    y0 = (np.arange(n0) * c // n0).astype(np.int64)
+    means = rng.normal(scale=4.0, size=(c, f)).astype(np.float32)
+    X0 = means[y0] + rng.normal(scale=1.0, size=(n0, f)).astype(np.float32)
+    stream = synthesize_stream(X0, y0, mult_data=mult, seed=0)
+    assert stream.src is not None  # compressed form — the packed plane's input
+    batches = stripe_partitions_packed(
+        stream, PARTITIONS, PER_BATCH, shuffle_seed=7
+    )
+    return batches, 64, True
+
+
+def main(coord: str, nproc: int, pid: int, mode: str = "plain") -> None:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", DEVICES_PER_PROC)
+
+    from distributed_drift_detection_tpu.config import DDMParams
+    from distributed_drift_detection_tpu.engine.loop import PackedIndexedBatches
     from distributed_drift_detection_tpu.models import ModelSpec, build_model
     from distributed_drift_detection_tpu.parallel import multihost
     from distributed_drift_detection_tpu.parallel.mesh import (
@@ -48,16 +95,11 @@ def main(coord: str, nproc: int, pid: int) -> None:
     n_global = nproc * DEVICES_PER_PROC
     assert len(jax.devices()) == n_global, jax.devices()
 
-    # Identical planted-drift stream on every host (same seed — the analog
-    # of every Spark executor seeing the same upstream dataframe).
-    rng = np.random.default_rng(0)
+    # Identical stream on every host (same seed — the analog of every Spark
+    # executor seeing the same upstream dataframe).
     c, f = 4, 6
-    n = PARTITIONS * 16 * PER_BATCH
-    y = (np.arange(n) * c // n).astype(np.int32)
-    means = rng.normal(scale=4.0, size=(c, f)).astype(np.float32)
-    X = means[y] + rng.normal(scale=1.0, size=(n, f)).astype(np.float32)
-    stream = StreamData(X, y, num_classes=c, dist_between_changes=n // c)
-    batches = stripe_partitions(stream, PARTITIONS, PER_BATCH)
+    build = {"plain": _plain_stream, "packed": _packed_stream}[mode]
+    batches, window, packed = build(c, f)
     keys = jax.random.split(jax.random.key(0), PARTITIONS)
     model = build_model("centroid", ModelSpec(f, c))
 
@@ -68,15 +110,25 @@ def main(coord: str, nproc: int, pid: int) -> None:
     per_host = PARTITIONS // nproc
     assert sl == slice(pid * per_host, (pid + 1) * per_host), sl
     local, lkeys = multihost.local_stripe(batches, keys, sl)
-    assert local.y.shape[0] == per_host
+    if packed:
+        assert isinstance(local, PackedIndexedBatches), type(local)
+        assert local.idx.shape[0] == per_host  # index planes cut to the host
+        assert local.base_X.shape == batches.base_X.shape  # table replicated
+    else:
+        assert local.y.shape[0] == per_host
     db, dk = multihost.shard_batches_global(local, lkeys, mesh, PARTITIONS)
-    assert db.y.shape[0] == PARTITIONS  # globally shaped, locally fed
-    runner = make_mesh_runner(model, DDMParams(), mesh, shuffle=False, window=4)
+    # Globally shaped, locally fed (sharded planes differ per form).
+    assert (db.idx if packed else db.y).shape[0] == PARTITIONS
+    runner = make_mesh_runner(
+        model, DDMParams(), mesh, shuffle=False, window=window, packed=packed
+    )
     out = runner(db, dk)
     jax.block_until_ready(out)
 
     # --- independent single-device reference inside this same process ---
-    single = make_mesh_runner(model, DDMParams(), None, shuffle=False, window=4)
+    single = make_mesh_runner(
+        model, DDMParams(), None, shuffle=False, window=window, packed=packed
+    )
     expect = single(jax.device_put(batches), jax.device_put(keys))
 
     # The drift vote is replicated across every device/host: fully
@@ -99,8 +151,13 @@ def main(coord: str, nproc: int, pid: int) -> None:
             )
         checked += got.change_global.shape[0]
     assert checked == per_host, (checked, per_host)
-    print(f"worker {pid}/{nproc}: OK ({checked} partitions checked)")
+    print(f"worker {pid}/{nproc} [{mode}]: OK ({checked} partitions checked)")
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4] if len(sys.argv) > 4 else "plain",
+    )

@@ -84,6 +84,7 @@ def test_linear_model_end_to_end(tmp_path):
     assert res.metrics.mean_delay_rows < 150
 
 
+@pytest.mark.slow
 def test_trace_dir_writes_profile(tmp_path):
     """RunConfig(trace_dir=...) wraps detect in a jax.profiler trace."""
     d = str(tmp_path / "trace")

@@ -2,6 +2,7 @@
 chunked feeder uses config.host_shuffle_seed — the cross-path contract."""
 
 import numpy as np
+import pytest
 
 from distributed_drift_detection_tpu import RunConfig, run
 from distributed_drift_detection_tpu.config import host_shuffle_seed
@@ -10,6 +11,7 @@ from distributed_drift_detection_tpu.io import chunk_stream_arrays, planted_prot
 from distributed_drift_detection_tpu.models import ModelSpec, build_model
 
 
+@pytest.mark.slow
 def test_chunked_matches_api_run_with_host_shuffle():
     stream = planted_prototypes(2, concepts=6, rows_per_concept=400, features=7)
     cfg = RunConfig(

@@ -25,6 +25,7 @@ def make_stream():
     return planted_prototypes(0, concepts=8, rows_per_concept=480, features=6)
 
 
+@pytest.mark.slow
 def test_chunked_equals_oneshot():
     """Same stream, same seed: chunked flags == one-shot flags exactly
     (including the PRNG shuffle stream across chunk boundaries)."""
@@ -53,6 +54,7 @@ def test_chunked_equals_oneshot():
     assert np.all(got.change_global[:, w:] == -1)
 
 
+@pytest.mark.slow
 def test_generator_chunks_sea():
     """1-shot SEA soak slice through the generator feeder: drift found in
     every partition, nothing materialised beyond one chunk."""
@@ -71,6 +73,7 @@ def test_generator_chunks_sea():
     assert det_counts.min() >= 1  # every partition sees the drifts
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_exact(tmp_path):
     """Stop after k chunks, checkpoint, restore into a fresh detector,
     continue: flags identical to an uninterrupted run."""
@@ -124,6 +127,7 @@ def test_fallback_retrain_cures_deadlock():
     assert (np.asarray(f1.change_global) >= 0).sum() == 0  # not fake changes
 
 
+@pytest.mark.slow
 def test_chunked_window_matches_sequential():
     """window>1 chunked = sequential chunked, bit-exact, for a
     deterministic-fit model with host-side (no in-jit) shuffling — the carry
@@ -147,6 +151,7 @@ def test_chunked_window_matches_sequential():
     assert (np.asarray(seq.change_global) >= 0).any()
 
 
+@pytest.mark.slow
 def test_chunked_window_checkpoint_resume():
     """Windowed chunked runs checkpoint/resume identically to a straight run."""
     import tempfile, os
@@ -180,6 +185,7 @@ def test_chunked_window_checkpoint_resume():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
 
 
+@pytest.mark.slow
 def test_chunked_mesh_sharded_matches_single_device():
     from distributed_drift_detection_tpu.parallel.mesh import make_mesh
 

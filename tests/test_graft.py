@@ -11,6 +11,7 @@ backends before resizing the CPU mesh).
 import sys
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -26,9 +27,11 @@ def test_entry_is_jittable():
     jax.jit(fn)(*args)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_on_virtual_mesh():
     graft.dryrun_multichip(8)  # asserts internally
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_smaller_mesh():
     graft.dryrun_multichip(2)

@@ -1,6 +1,7 @@
 """Grid harness + aggregation (reference C12-C15 semantics)."""
 
 import numpy as np
+import pytest
 
 from distributed_drift_detection_tpu import RunConfig
 from distributed_drift_detection_tpu.harness import (
@@ -55,8 +56,6 @@ def test_grid_spec_rule_warns_and_skips(tmp_path):
     is code, not convention: off-spec (dataset, mult, partitions) cells warn
     by default, are dropped with spec='skip', and run silently with
     spec='off'."""
-    import pytest
-
     from distributed_drift_detection_tpu.harness import off_spec_reason
 
     base = base_cfg(tmp_path)
@@ -233,8 +232,6 @@ def test_argv_entry_point_reference_contract(tmp_path, monkeypatch, capsys):
 
 
 def test_argv_entry_point_rejects_partial_args():
-    import pytest
-
     from distributed_drift_detection_tpu.__main__ import main
 
     with pytest.raises(SystemExit, match="usage"):
@@ -251,6 +248,7 @@ def _append_worker(args):
     return i
 
 
+@pytest.mark.slow
 def test_append_result_concurrent_writers(tmp_path):
     """Concurrent appends from many processes produce a well-formed CSV:
     exactly one header, every row intact (the reference's multi-invocation

@@ -129,6 +129,7 @@ def test_packed_expands_to_indexed_bitwise(shuffle_seed):
         )
 
 
+@pytest.mark.slow
 def test_mesh_runner_packed_equals_indexed_sharded():
     """The packed transport changes nothing observable, sharded or not."""
     from distributed_drift_detection_tpu.io import stripe_partitions_packed

@@ -23,6 +23,11 @@ import time
 
 import pytest
 
+# Fresh-process jax.distributed launches: ~30-60 s per topology × mode —
+# the heaviest contracts in the suite, slow-tier by file (test_multihost.py
+# keeps the single-process multihost seams in the fast tier).
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 
@@ -32,7 +37,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(nproc: int, timeout: int = 420) -> list:
+def _launch(nproc: int, timeout: int = 420, mode: str = "plain") -> list:
     coord = f"127.0.0.1:{_free_port()}"
     from distributed_drift_detection_tpu.utils.hermetic import hermetic_cpu_env
 
@@ -44,7 +49,7 @@ def _launch(nproc: int, timeout: int = 420) -> list:
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, coord, str(nproc), str(pid)],
+            [sys.executable, _WORKER, coord, str(nproc), str(pid), mode],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -83,9 +88,13 @@ def _launch(nproc: int, timeout: int = 420) -> list:
     ]
 
 
+@pytest.mark.parametrize("mode", ["plain", "packed"])
 @pytest.mark.parametrize("nproc", [2, 4])
-def test_multiprocess_flags_match_single_device(nproc):
-    outs = _launch(nproc)
+def test_multiprocess_flags_match_single_device(nproc, mode):
+    """Both data planes with process_count() > 1, both topologies: the
+    dense/window=4 plane and the shipped flagship transport (packed
+    compressed stream + window=64 — what bench.py measures)."""
+    outs = _launch(nproc, mode=mode)
     for pid, (rc, out) in enumerate(outs):
-        assert rc == 0, f"worker {pid}/{nproc} failed:\n{out[-4000:]}"
-        assert f"worker {pid}/{nproc}: OK" in out, out[-2000:]
+        assert rc == 0, f"worker {pid}/{nproc} [{mode}] failed:\n{out[-4000:]}"
+        assert f"worker {pid}/{nproc} [{mode}]: OK" in out, out[-2000:]

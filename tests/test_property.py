@@ -171,6 +171,7 @@ def _engines(window):
     return _ENGINES[window]
 
 
+@pytest.mark.slow
 @settings(max_examples=12, deadline=None)
 @given(data=st.data())
 def test_window_engine_matches_sequential_on_adversarial_streams(data):

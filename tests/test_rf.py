@@ -52,6 +52,7 @@ def test_rf_matches_centroid_detections(stream):
     )
 
 
+@pytest.mark.slow
 def test_rf_window_engine(stream):
     """The speculative window engine composes with the host callback.
 
@@ -69,6 +70,7 @@ def test_rf_window_engine(stream):
     )
 
 
+@pytest.mark.slow
 def test_rf_runs_unsharded_on_multidevice_host():
     """model='rf' must not build a sharded mesh program: host callbacks
     inside an SPMD computation deadlock the CPU collective rendezvous (one
