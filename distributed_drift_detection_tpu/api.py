@@ -91,6 +91,7 @@ def _cached_runner(
             detector=make_detector(
                 cfg.detector, ddm=cfg.ddm, ph=cfg.ph, eddm=cfg.eddm
             ),
+            rotations=cfg.window_rotations,
         )
         return runner, mesh
 
@@ -100,7 +101,7 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.detector, cfg.ph, cfg.eddm,
+        cfg.detector, cfg.ph, cfg.eddm, cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

@@ -151,6 +151,18 @@ class RunConfig:
     # are seed-equivalent but not bit-equal across different window values —
     # pin window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
     window: int = 16
+    # Speculation depth of the window engine (engine.window): how many
+    # rotate-and-replay passes one sequential step may commit. 1 = classic
+    # single-rotation speculation; R > 1 replays up to R−1 times inside the
+    # same step — after an in-window change the model refits and the tail
+    # re-runs immediately — cutting sequential steps from ≈ NB/W + drifts
+    # toward ≈ NB/W + drifts/R. Flags are bit-identical to the sequential
+    # engine for deterministic-fit models at any depth (tested); like
+    # `window`, the depth is part of 'mlp'/'rf''s seed story. Each level
+    # costs one extra predict + detector pass of device work per step —
+    # pure win in the dispatch-latency-bound regimes the window engine
+    # exists for, wasted FLOPs where drift is absent (keep 1 there).
+    window_rotations: int = 1
     # (Two rejected-by-measurement alternatives are documented in PARITY.md:
     # a `ddm_kernel='pallas'` fused kernel — ~78× slower than the XLA
     # lowering, removed in round 2 ("Pallas post-mortem") — and a

@@ -59,6 +59,7 @@ class ChunkedDetector:
         window: int = 1,
         mesh=None,
         detector=None,
+        rotations: int = 1,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -72,7 +73,9 @@ class ChunkedDetector:
         # flags are bit-identical for deterministic-fit models with
         # host-side shuffling (shuffle=False here + the feeder's
         # shuffle_seed); with the in-jit shuffle the PRNG streams differ
-        # (keys split per window vs per batch).
+        # (keys split per window vs per batch). ``rotations`` is the window
+        # engine's speculation depth (make_window_span) — same exactness
+        # contract, fewer sequential steps per drift; ignored at window=1.
         self.model = model
         self.partitions = partitions
         self._detector = resolve_detector(ddm_params, detector)
@@ -92,8 +95,13 @@ class ChunkedDetector:
                 shuffle=shuffle,
                 retrain_error_threshold=retrain_error_threshold,
                 detector=self._detector,
+                rotations=rotations,
             )
             run_chunk = span
+        elif rotations != 1:
+            raise ValueError(
+                "rotations only applies to the window engine (window > 1)"
+            )
         else:
             step = make_partition_step(
                 model,

@@ -71,6 +71,7 @@ def make_window_span(
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
     detector=None,
+    rotations: int = 1,
 ):
     """Build ``span(carry: LoopCarry, batches) -> (LoopCarry, FlagRows)``.
 
@@ -83,12 +84,36 @@ def make_window_span(
     never span a chunk boundary; with chunk length ≫ window the lost
     speculation is negligible.
 
+    ``rotations`` is the **speculation depth**: how many rotate-and-replay
+    passes one sequential iteration may commit. At the default 1, an
+    iteration commits up to the first in-window change and the discarded
+    tail re-executes next iteration, so the sequential-step count is
+    ``≈ NB/W + drifts`` — on a latency-bound device (remote-TPU dispatch,
+    small per-step FLOPs) the ``drifts`` term dominates at benchmark
+    geometry (39 of ~59 steps at the mult=512 headline). ``rotations = R``
+    replays up to ``R−1`` times *inside the same iteration*: after a change
+    at window row ``c``, rows ``≤ c`` are masked invalid, the model refits
+    on batch ``c`` (exactly the sequential rotate), the detector restarts
+    from a reset state, and the remaining rows are re-predicted — committing
+    up to ``R`` changes per step and cutting the count toward
+    ``≈ NB/W + drifts/R``. Each level adds one predict + one detector
+    prefix pass of device work (trivial at these shapes, so the trade is
+    pure win in the latency-bound regime). Flags are bit-identical to the
+    sequential engine for deterministic-fit models regardless of ``R``
+    (tested); key-consuming fits ('mlp', 'rf') draw their fit keys per
+    *level*, so — exactly like the ``window`` width — ``rotations`` is part
+    of their seed story ('seed-equivalent, not bit-equal' across different
+    values).
+
     Pure and jit/vmap-compatible; under ``vmap`` partitions advance their own
     window pointers in lock-step iterations (finished lanes freeze — their
     writes land in the pad region).
     """
     w = int(window)
+    r_levels = int(rotations)
     assert w >= 1
+    if r_levels < 1:
+        raise ValueError(f"rotations must be >= 1, got {rotations}")
     det = resolve_detector(ddm_params, detector)
     # The window statistic runs as XLA primitives (cumsum + associative_scan,
     # ops/ddm.py). A fused Pallas twin was measured and removed in round 2 —
@@ -153,6 +178,12 @@ def make_window_span(
             # independent of other lanes' progress.
             active = st.ptr < nbf
             key, k_fit, k_shuf = jax.random.split(st.key, 3)
+            # One fit key per speculation level; level 0 uses k_fit directly
+            # so rotations=1 reproduces the historical key stream bit-exactly
+            # (the window engine's 'mlp'/'rf' seed contract).
+            k_fits = [k_fit] if r_levels == 1 else list(
+                jax.random.split(k_fit, r_levels)
+            )
 
             sl_rows = lax.dynamic_slice_in_dim(r_rows, st.ptr, w, 0)
             sl_valid = lax.dynamic_slice_in_dim(r_valid, st.ptr, w, 0)
@@ -179,80 +210,124 @@ def make_window_span(
             if indexed:
                 sl_X, sl_y = mat_X(sl_idx), mat_y(sl_idx)
 
-            ne = jnp.any(sl_valid, axis=1)  # [W] nonempty batches
-            any_ne = jnp.any(ne)
-
-            # Train-on-demand (C7 :194-196): the model is frozen inside the
-            # window — retrain can only be pending at window start.
-            fitted = model.fit(k_fit, st.a_X, st.a_y, st.a_w)
-            pred_params = _select(st.retrain & any_ne, fitted, st.params)
-
-            # One chunky prediction for the whole window (W·B rows).
-            preds = model.predict(
-                pred_params, sl_X.reshape(w * b, -1)
-            ).reshape(w, b)
-            errs = (preds != sl_y).astype(jnp.float32)
-
-            # Speculative DDM over the flattened window (state flows across
-            # batch boundaries — ``DDM_Process.py:202``).
-            new_ddm, res = _det_window(st.ddm, errs, sl_valid)
-            change = (res.first_change >= 0) & ne  # [W]
-
-            if retrain_error_threshold is not None:
-                bw = sl_valid.astype(jnp.float32)
-                err_rate = jnp.sum(errs * bw, axis=1) / jnp.maximum(
-                    jnp.sum(bw, axis=1), 1.0
-                )
-                forced = ne & ~change & (err_rate > retrain_error_threshold)
-            else:
-                forced = jnp.zeros(w, bool)
-            rotate = change | forced
-
-            # Commit everything up to (and including) the first rotating
-            # batch; discard + re-execute the rest (the sequential loop would
-            # have reset + retrained there, DDM_Process.py:207-210).
-            any_rot = jnp.any(rotate)
-            rpos = jnp.argmax(rotate).astype(i32)
+            rows_w = jnp.arange(w, dtype=i32)
             remaining = nbf - st.ptr
-            adv = jnp.where(any_rot, rpos + 1, i32(w))
-            adv = jnp.where(active, jnp.minimum(adv, remaining), i32(0))
 
-            # Flag slabs for the whole window; rows past the commit point are
-            # overwritten by the next window (monotone ptr), rows past NBF
-            # land in the pad region and are sliced off at the end.
+            # Speculation-level loop (unrolled: r_levels is static). Level 0
+            # is the classic speculative pass over the whole window; each
+            # further level replays the uncommitted tail after an in-window
+            # rotate — mask rows ≤ the change point invalid, refit on the
+            # change batch (the sequential rotate, DDM_Process.py:207-210),
+            # restart the detector from a reset state, re-predict. All level
+            # state is data (where-selected), so the unrolled code is one
+            # straight-line XLA program.
+            params_c, ddm_c = st.params, st.ddm
+            a_X_c, a_y_c, a_w_c = st.a_X, st.a_y, st.a_w
+            retr_c = st.retrain
+            start = i32(0)  # first uncommitted window row
+            open_ = jnp.bool_(True)  # this window still has rows to process
             slab = FlagRows(
-                warning_local=res.first_warning,
-                warning_global=jax.vmap(_gather_row)(sl_rows, res.first_warning),
-                change_local=res.first_change,
-                change_global=jax.vmap(_gather_row)(sl_rows, res.first_change),
-                forced_retrain=forced,
+                warning_local=jnp.full(w, -1, i32),
+                warning_global=jnp.full(w, -1, i32),
+                change_local=jnp.full(w, -1, i32),
+                change_global=jnp.full(w, -1, i32),
+                forced_retrain=jnp.zeros(w, bool),
             )
+
+            for lvl in range(r_levels):
+                live = sl_valid & (rows_w >= start)[:, None] & open_
+                ne = jnp.any(live, axis=1)  # [W] nonempty live batches
+                any_ne = jnp.any(ne)
+
+                # Train-on-demand (C7 :194-196): the model is frozen within
+                # a level — retrain can only be pending at level start.
+                fitted = model.fit(k_fits[lvl], a_X_c, a_y_c, a_w_c)
+                use_fit = retr_c & any_ne
+                pred_params = _select(use_fit, fitted, params_c)
+
+                # One chunky prediction for the whole window (W·B rows).
+                preds = model.predict(
+                    pred_params, sl_X.reshape(w * b, -1)
+                ).reshape(w, b)
+                errs = (preds != sl_y).astype(jnp.float32)
+
+                # Speculative detector pass over the flattened live region
+                # (state flows across batch boundaries — DDM_Process.py:202).
+                new_ddm, res = _det_window(ddm_c, errs, live)
+                change = (res.first_change >= 0) & ne  # [W]
+
+                if retrain_error_threshold is not None:
+                    bw = live.astype(jnp.float32)
+                    err_rate = jnp.sum(errs * bw, axis=1) / jnp.maximum(
+                        jnp.sum(bw, axis=1), 1.0
+                    )
+                    forced = ne & ~change & (err_rate > retrain_error_threshold)
+                else:
+                    forced = jnp.zeros(w, bool)
+                rotate = change | forced
+
+                # This level commits rows [start, end): up to and including
+                # the first rotating batch, or the whole tail if none.
+                any_rot = jnp.any(rotate)
+                rpos = jnp.argmax(rotate).astype(i32)
+                end = jnp.where(any_rot, rpos + 1, i32(w))
+                row_mask = open_ & (rows_w >= start) & (rows_w < end)
+                lvl_slab = FlagRows(
+                    warning_local=res.first_warning,
+                    warning_global=jax.vmap(_gather_row)(
+                        sl_rows, res.first_warning
+                    ),
+                    change_local=res.first_change,
+                    change_global=jax.vmap(_gather_row)(
+                        sl_rows, res.first_change
+                    ),
+                    forced_retrain=forced,
+                )
+                slab = jax.tree.map(
+                    lambda part, full: jnp.where(row_mask, part, full),
+                    lvl_slab, slab,
+                )
+
+                # Rotate state from the first rotating batch; commit the fit
+                # if a nonempty batch was actually processed with it.
+                ne_cov = ne & (rows_w < end)
+                any_ne_cov = jnp.any(ne_cov)
+                take_rot = open_ & any_rot
+                params_c = _select(
+                    open_ & retr_c & any_ne_cov, fitted, params_c
+                )
+                ddm_c = _select(
+                    open_, _select(any_rot, det.init(), new_ddm), ddm_c
+                )
+                a_X_c = _select(take_rot, sl_X[rpos], a_X_c)
+                a_y_c = _select(take_rot, sl_y[rpos], a_y_c)
+                a_w_c = _select(
+                    take_rot, sl_valid[rpos].astype(jnp.float32), a_w_c
+                )
+                retr_c = jnp.where(open_ & any_ne_cov, any_rot, retr_c)
+                start = jnp.where(open_, end, start)
+                open_ = open_ & any_rot
+
+            adv = jnp.where(active, jnp.minimum(start, remaining), i32(0))
+
+            # Write the committed slab; rows past the commit point hold −1
+            # and are overwritten by the next window (monotone ptr), rows
+            # past NBF land in the pad region and are sliced off at the end.
             write_at = jnp.where(active, st.ptr, i32(nbf))
             flags = FlagRows(*(
                 lax.dynamic_update_slice_in_dim(full, part, write_at, 0)
                 for full, part in zip(st.flags, slab)
             ))
 
-            # Rotate state (C7 :207-210), from the first rotating batch.
-            ne_cov = ne & (jnp.arange(w, dtype=i32) < adv)
-            any_ne_cov = jnp.any(ne_cov)
-            take_rot = active & any_rot
             upd = lambda new, old: _select(active, new, old)  # noqa: E731
             return _WinState(
                 ptr=st.ptr + adv,
-                params=upd(
-                    _select(st.retrain & any_ne_cov, fitted, st.params),
-                    st.params,
-                ),
-                ddm=upd(_select(any_rot, det.init(), new_ddm), st.ddm),
-                a_X=_select(take_rot, sl_X[rpos], st.a_X),
-                a_y=_select(take_rot, sl_y[rpos], st.a_y),
-                a_w=_select(
-                    take_rot, sl_valid[rpos].astype(jnp.float32), st.a_w
-                ),
-                retrain=jnp.where(
-                    active & any_ne_cov, any_rot, st.retrain
-                ),
+                params=upd(params_c, st.params),
+                ddm=upd(ddm_c, st.ddm),
+                a_X=upd(a_X_c, st.a_X),
+                a_y=upd(a_y_c, st.a_y),
+                a_w=upd(a_w_c, st.a_w),
+                retrain=jnp.where(active, retr_c, st.retrain),
                 key=upd(key, st.key),
                 flags=flags,
             )
@@ -280,11 +355,13 @@ def make_window_runner(
     shuffle: bool = False,
     retrain_error_threshold: float | None = None,
     detector=None,
+    rotations: int = 1,
 ):
     """Build ``run(batches: Batches, key) -> FlagRows`` for one partition.
 
     Output contract is identical to ``engine.loop.make_partition_runner``:
     ``FlagRows`` leaves of shape ``[NB - 1]`` (batch 0 seeds ``batch_a``).
+    ``rotations`` is the speculation depth (:func:`make_window_span`).
     """
     det = resolve_detector(ddm_params, detector)
     span = make_window_span(
@@ -294,6 +371,7 @@ def make_window_runner(
         shuffle=shuffle,
         retrain_error_threshold=retrain_error_threshold,
         detector=det,
+        rotations=rotations,
     )
 
     def run(batches: Batches | IndexedBatches, key: jax.Array) -> FlagRows:

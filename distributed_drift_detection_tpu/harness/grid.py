@@ -69,11 +69,15 @@ def _config_key(cfg: RunConfig) -> str:
         if cfg.retrain_error_threshold is not None  # 0.0 is an active setting
         else ""
     )
-    # Key-consuming fits (mlp, rf) draw PRNG keys per window, so their flags
-    # depend on the window width (config.py's 'seed-equivalent but not
-    # bit-equal' caveat); deterministic fits are window-invariant (tested),
-    # so their historical keys stay stable.
-    win = f"-w{cfg.window}" if cfg.model in ("mlp", "rf") else ""
+    # Key-consuming fits (mlp, rf) draw PRNG keys per window/level, so their
+    # flags depend on the window width and speculation depth (config.py's
+    # 'seed-equivalent but not bit-equal' caveat); deterministic fits are
+    # invariant to both (tested), so their historical keys stay stable — as
+    # do rotations=1 keys (the suffix only appears at non-default depth).
+    win = ""
+    if cfg.model in ("mlp", "rf"):
+        rot = f"r{cfg.window_rotations}" if cfg.window_rotations != 1 else ""
+        win = f"-w{cfg.window}{rot}"
     # The detector segment carries the active statistic's name + full
     # parameter tuple. The default DDM keeps the historical key shape
     # (``-ddm<min>_<warn>_<out>``) so existing results CSVs still resume;
@@ -204,7 +208,7 @@ def run_grid(
     for i, cfg in enumerate(todo):
         static_key = (
             cfg.dataset, cfg.mult_data, cfg.partitions, cfg.model,
-            cfg.detector, cfg.per_batch, cfg.window,
+            cfg.detector, cfg.per_batch, cfg.window, cfg.window_rotations,
         )
         if warmup and static_key != warmed:
             run(replace(cfg, results_csv="", time_string="warmup"))

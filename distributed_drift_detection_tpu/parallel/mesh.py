@@ -119,6 +119,7 @@ def make_mesh_runner(
     indexed: bool = False,
     packed: bool = False,
     detector=None,
+    rotations: int = 1,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
@@ -135,6 +136,8 @@ def make_mesh_runner(
     :class:`PackedIndexedBatches` and synthesizes the geometry planes
     in-jit (``expand_packed``) before the engines see them — the engines
     and their flags are identical, only the host→device transfer shrinks.
+    ``rotations`` is the window engine's speculation depth
+    (``engine.window.make_window_span``); ignored by the sequential engine.
     """
     from ..models.base import require_shardable
 
@@ -158,6 +161,7 @@ def make_mesh_runner(
             shuffle=shuffle,
             retrain_error_threshold=retrain_error_threshold,
             detector=detector,
+            rotations=rotations,
         )
     else:
         run_one = make_partition_runner(

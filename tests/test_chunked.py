@@ -137,18 +137,24 @@ def test_chunked_window_matches_sequential():
     spec = ModelSpec(stream.num_features, stream.num_classes)
     model = build_model("centroid", spec)
 
-    def flags_with(window):
-        det = ChunkedDetector(model, REF, partitions=p, seed=0, window=window)
+    def flags_with(window, rotations=1):
+        det = ChunkedDetector(
+            model, REF, partitions=p, seed=0, window=window,
+            rotations=rotations,
+        )
         chunks = chunk_stream_arrays(
             stream.X, stream.y, p, b, chunk_batches=6, shuffle_seed=11
         )
         return det.run(chunks)
 
     seq = flags_with(1)
-    win = flags_with(5)
-    for a, c in zip(seq, win):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    for win in (flags_with(5), flags_with(5, rotations=3)):
+        for a, c in zip(seq, win):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
     assert (np.asarray(seq.change_global) >= 0).any()
+
+    with pytest.raises(ValueError, match="rotations"):
+        ChunkedDetector(model, REF, partitions=p, window=1, rotations=2)
 
 
 @pytest.mark.slow

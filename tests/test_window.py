@@ -92,9 +92,42 @@ def test_window_runner_matches_sequential(window, model_name):
     np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
 
 
-def test_window_runner_with_noise_and_forced_retrain():
+@pytest.mark.parametrize("rotations", [2, 4, 11])
+@pytest.mark.parametrize("window", [3, 16, 64])
+def test_multi_rotation_speculation_matches_sequential(window, rotations):
+    """Speculation depth > 1 (rotate-and-replay inside one step) commits
+    bit-identical flags to the sequential engine for every (W, R) — the
+    depth is an execution strategy, not a semantics change. W=64 spans
+    several concepts, so one step genuinely commits multiple rotations."""
+    rng = np.random.default_rng(rotations * 7 + window)
+    X, y = planted_classification_stream(
+        rng, concepts=7, rows_per_concept=230, label_flip=0
+    )
+    spec = ModelSpec(X.shape[1], int(y.max()) + 1)
+    model = build_model("centroid", spec)
+    batches = to_batches(X, y, 50)
+    key = jax.random.key(9)
+
+    seq = jax.jit(make_partition_runner(model, REF, shuffle=False))(batches, key)
+    win = jax.jit(
+        make_window_runner(
+            model, REF, window=window, shuffle=False, rotations=rotations
+        )
+    )(batches, key)
+    np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
+
+
+def test_multi_rotation_rejects_bad_depth():
+    with pytest.raises(ValueError, match="rotations"):
+        make_window_runner(
+            make_majority(ModelSpec(4, 2)), REF, window=4, rotations=0
+        )
+
+
+@pytest.mark.parametrize("rotations", [1, 4])
+def test_window_runner_with_noise_and_forced_retrain(rotations):
     """Noisy labels + retrain_error_threshold: rotates from both DDM changes
-    and forced retrains still commit identically."""
+    and forced retrains still commit identically (at any speculation depth)."""
     rng = np.random.default_rng(123)
     X, y = planted_classification_stream(
         rng, concepts=5, rows_per_concept=300, label_flip=0.05
@@ -106,7 +139,9 @@ def test_window_runner_with_noise_and_forced_retrain():
     kw = dict(shuffle=False, retrain_error_threshold=0.3)
 
     seq = jax.jit(make_partition_runner(model, REF, **kw))(batches, key)
-    win = jax.jit(make_window_runner(model, REF, window=8, **kw))(batches, key)
+    win = jax.jit(
+        make_window_runner(model, REF, window=8, rotations=rotations, **kw)
+    )(batches, key)
     np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
 
 
