@@ -75,7 +75,8 @@ class ChunkedDetector:
         # shuffle_seed); with the in-jit shuffle the PRNG streams differ
         # (keys split per window vs per batch). ``rotations`` is the window
         # engine's speculation depth (make_window_span) — same exactness
-        # contract, fewer sequential steps per drift; ignored at window=1.
+        # contract, fewer sequential steps per drift; requires window > 1
+        # (rejected otherwise, matching parallel.mesh.make_mesh_runner).
         self.model = model
         self.partitions = partitions
         self._detector = resolve_detector(ddm_params, detector)

@@ -137,7 +137,8 @@ def make_mesh_runner(
     in-jit (``expand_packed``) before the engines see them — the engines
     and their flags are identical, only the host→device transfer shrinks.
     ``rotations`` is the window engine's speculation depth
-    (``engine.window.make_window_span``); ignored by the sequential engine.
+    (``engine.window.make_window_span``); it requires ``window > 1``
+    (rejected otherwise, matching ``ChunkedDetector``).
     """
     from ..models.base import require_shardable
 
@@ -151,6 +152,13 @@ def make_mesh_runner(
         )
     if indexed and window <= 1:
         raise ValueError("indexed batches require the window engine (window > 1)")
+    if window <= 1 and rotations != 1:
+        # Same contract as ChunkedDetector: the knob only exists on the
+        # window engine, and silently ignoring it (or an invalid 0) would
+        # make RunConfig(window=1, window_rotations=...) a no-op surface.
+        raise ValueError(
+            "rotations only applies to the window engine (window > 1)"
+        )
     if window > 1:
         from ..engine.window import make_window_runner
 

@@ -30,13 +30,21 @@ def main():
         # stream's planted-drift geometry by default — PHParams.threshold = 0
         # → config.auto_ph_threshold; pass PHParams(threshold=...) to pin it.
     )
-    print(f"{'detector':<10} {'detections':>10} {'mean delay (rows)':>18} "
-          f"{'Final Time (s)':>15}")
+    from distributed_drift_detection_tpu.metrics import attribution_metrics
+
+    print(f"{'detector':<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
+          f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
     for name in ("ddm", "ph", "eddm"):
         res = run(replace(base, detector=name))
         m = res.metrics
-        delay = f"{m.mean_delay_rows:.1f}" if m.num_detections else "-"
-        print(f"{name:<10} {m.num_detections:>10} {delay:>18} "
+        a = attribution_metrics(
+            res.flags.change_global,
+            res.stream.dist_between_changes,
+            res.stream.num_rows,
+        )
+        fh = f"{a.mean_first_hit_delay_rows:.1f}" if a.hits else "-"
+        print(f"{name:<10} {m.num_detections:>10} {a.hits:>6} "
+              f"{a.spurious:>9} {a.recall:>7.3f} {fh:>16} "
               f"{res.total_time:>15.3f}")
 
 

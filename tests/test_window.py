@@ -230,3 +230,12 @@ def test_window_shuffle_mode_detects_boundaries():
     detected = detected[detected >= 0]
     assert set((detected // rpc).tolist()) == set(range(1, concepts))
     assert (detected % rpc).max() <= 2 * per_batch
+
+
+def test_mesh_runner_rejects_rotations_without_window():
+    from distributed_drift_detection_tpu.parallel.mesh import make_mesh_runner
+
+    with pytest.raises(ValueError, match="rotations"):
+        make_mesh_runner(
+            make_majority(ModelSpec(4, 2)), REF, None, window=1, rotations=4
+        )
