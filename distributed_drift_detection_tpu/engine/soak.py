@@ -164,6 +164,7 @@ def make_soak_runner(
     detector=None,
     window: int = 1,
     chunk_batches: int = 0,
+    rotations: int = 1,
 ):
     """Build ``run(key) -> SoakResult``: the full soak as ONE device program.
 
@@ -180,9 +181,13 @@ def make_soak_runner(
     (default ``2·window``; generated in one vmapped shot, bounding the
     transient generator buffer), each processed by ``engine.window``'s span —
     cutting the sequential iteration count from ``NB`` to roughly
-    ``NB/chunk_batches + NB/window + drifts``. Same flags as the sequential
-    scan for deterministic-fit models (the window engine's exactness
-    contract; keys split per window, so 'mlp' is seed-equivalent only).
+    ``NB/chunk_batches + NB/window + drifts``. ``rotations`` is that span's
+    speculation depth (``engine.window.make_window_span``: commit up to R
+    changes per step, shrinking the ``drifts`` term toward ``drifts/R``);
+    it requires ``window > 1`` (rejected otherwise, like every other engine
+    surface). Same flags as the sequential scan for deterministic-fit
+    models (the window engine's exactness contract; keys split per
+    window/level, so 'mlp' is seed-equivalent only).
 
     When it helps: small per-step workloads (small ``per_batch`` × few
     partitions), where the scan is iteration-latency-bound — the same regime
@@ -229,11 +234,16 @@ def make_soak_runner(
             "chunk_batches only applies to the windowed soak (window > 1); "
             "the sequential scan does not chunk"
         )
+    if window <= 1 and rotations != 1:
+        raise ValueError(
+            "rotations only applies to the window engine (window > 1)"
+        )
     if window > 1:
         from .window import make_window_span
 
         span = make_window_span(
-            model, ddm_params, window=window, shuffle=False, detector=det
+            model, ddm_params, window=window, shuffle=False, detector=det,
+            rotations=rotations,
         )
         cb = int(chunk_batches) or 2 * int(window)
     else:

@@ -3,8 +3,10 @@
 The reference ships a single statistic (skmultiflow's DDM,
 ``DDM_Process.py:133``); this framework adds Page–Hinkley and EDDM behind
 the same engine seam (``ops/detectors.py``). This example runs all three on
-the same stream/model/seed and reports detections + mean delay side by
-side — the quickest way to see how their sensitivity profiles differ.
+the same stream/model/seed and reports boundary-attributed quality side by
+side — detections decomposed into first hits vs spurious extra fires, with
+recall and hit-based delay (``metrics.attribution_metrics``) — the quickest
+way to see how their sensitivity profiles differ.
 
     python examples/detector_zoo.py [dataset.csv] [mult] [partitions]
 """

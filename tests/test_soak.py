@@ -65,20 +65,32 @@ def test_unknown_generator_rejected():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("window,chunk_batches", [(8, 11), (16, 0)])
-def test_window_soak_matches_sequential(window, chunk_batches):
+@pytest.mark.parametrize(
+    "window,chunk_batches,rotations", [(8, 11, 1), (16, 0, 1), (16, 0, 3)]
+)
+def test_window_soak_matches_sequential(window, chunk_batches, rotations):
     """The windowed soak (speculative span over device-generated chunks) is
     bit-identical to the batch-per-step scan, including ragged last chunks
     (39 flag batches: chunk_batches=11 leaves a 6-batch tail, auto cb=32
-    leaves a 7-batch tail — both exercise the invalid-tail masking)."""
+    leaves a 7-batch tail — both exercise the invalid-tail masking) and at
+    speculation depth > 1."""
     seq = _run(num_batches=40, drift_every=1500)
     win = _run(
         num_batches=40, drift_every=1500,
-        window=window, chunk_batches=chunk_batches,
+        window=window, chunk_batches=chunk_batches, rotations=rotations,
     )
     for name, a, b in zip(seq.flags._fields, seq.flags, win.flags):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
     assert win.rows_processed == seq.rows_processed
+
+
+def test_soak_rejects_rotations_without_window():
+    with pytest.raises(ValueError, match="rotations"):
+        make_soak_runner(
+            build_model("centroid", ModelSpec(8, 8)),
+            partitions=2, per_batch=10, num_batches=5, drift_every=100,
+            rotations=2,
+        )
 
 
 @pytest.mark.slow
