@@ -227,8 +227,12 @@ def main() -> None:
     from distributed_drift_detection_tpu.parallel.mesh import unpack_flags
     from distributed_drift_detection_tpu.utils.timing import PhaseTimer
 
+    # argv: [mult] [partitions] [window] [rotations] — the last two expose
+    # the speculative engine's knobs for on-hardware sweeps via this CLI.
     mult = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    window = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 1
     cfg = RunConfig(
         dataset="/root/reference/outdoorStream.csv",
         mult_data=mult,
@@ -240,7 +244,8 @@ def main() -> None:
         # iteration count, not per-step FLOPs, bounds the detect phase, and
         # measured medians improve monotonically up to the clamp (W=64
         # ≈ 0.50 s vs W=16 ≈ 0.62 s end-to-end at mult=512).
-        window=64,
+        window=window,
+        window_rotations=rotations,
         results_csv="",
     )
     prep = prepare(cfg)
@@ -314,6 +319,10 @@ def main() -> None:
                 "phase_s": phases,
                 "rows": stream.num_rows,
                 "partitions": cfg.partitions,
+                # From the resolved config: window=0 (auto) is resolved to a
+                # concrete width inside prepare() — report that, not argv.
+                "window": prep.config.window,
+                "window_rotations": prep.config.window_rotations,
                 "mean_delay_batches": (
                     round(delay_batches, 3) if np.isfinite(delay_batches) else None
                 ),
