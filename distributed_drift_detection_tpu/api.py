@@ -31,6 +31,7 @@ import numpy as np
 from .config import (
     RunConfig,
     auto_ph_threshold,
+    auto_rotations,
     auto_window,
     host_shuffle_seed,
     replace,
@@ -129,8 +130,13 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # transfer than the materialized stream at mult=512 (~2.3× less than
     # the round-1 indexed form).
     # window == 0 → auto-size from the stream's planted drift spacing;
+    # window_rotations == 0 → auto depth (needs the resolved window first);
     # ph.threshold == 0 → auto-tune λ from the same geometry.
     cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
+    cfg = replace(
+        cfg,
+        window_rotations=auto_rotations(cfg, stream.dist_between_changes),
+    )
     if cfg.detector == "ph":  # auto_ph_threshold passes an explicit λ through
         cfg = replace(
             cfg,

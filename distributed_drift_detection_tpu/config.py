@@ -162,6 +162,8 @@ class RunConfig:
     # costs one extra predict + detector pass of device work per step —
     # pure win in the dispatch-latency-bound regimes the window engine
     # exists for, wasted FLOPs where drift is absent (keep 1 there).
+    # 0 = auto: resolve the depth from stream geometry (the concepts one
+    # window spans, +1; config.auto_rotations — the auto_window pattern).
     window_rotations: int = 1
     # (Two rejected-by-measurement alternatives are documented in PARITY.md:
     # a `ddm_kernel='pallas'` fused kernel — ~78× slower than the XLA
@@ -227,6 +229,30 @@ def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:
 
     w = 1 << (round(math.log2(bpc)) if bpc > 1 else 0)
     return int(min(64, max(4, w)))
+
+
+def auto_rotations(cfg: RunConfig, dist_between_changes: int) -> int:
+    """Resolve ``window_rotations == 0`` (auto) from stream geometry.
+
+    A window of ``W`` batches covers ``W · per_batch`` elements of one
+    partition's stream; with planted concepts of ``dist_between_changes /
+    partitions`` elements per partition it spans ≈ ``L/cpp`` boundaries,
+    each costing one replay level. Depth = round(boundaries-per-window) + 1
+    commits a typical window in one step even when every spanned boundary
+    fires, clamped to [1, 8] (beyond ~8 the per-level predict/detector cost
+    rivals the saved iterations at typical shapes). Windows much smaller
+    than a concept round to depth 1 — paying an every-step replay level for
+    a rare boundary-straddling window is a loss. Resolution needs the
+    *resolved* window — call after :func:`auto_window`. Streams without
+    planted geometry keep depth 1 (speculating on absent drift is waste).
+    """
+    if cfg.window_rotations:
+        return cfg.window_rotations
+    if dist_between_changes <= 0 or cfg.window <= 1:
+        return 1
+    concept_pp = dist_between_changes / max(cfg.partitions, 1)
+    per_window = cfg.window * cfg.per_batch
+    return int(min(8, max(1, round(per_window / concept_pp) + 1)))
 
 
 def auto_ph_threshold(cfg: RunConfig, dist_between_changes: int) -> float:

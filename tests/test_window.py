@@ -239,3 +239,41 @@ def test_mesh_runner_rejects_rotations_without_window():
         make_mesh_runner(
             make_majority(ModelSpec(4, 2)), REF, None, window=1, rotations=4
         )
+
+
+def test_auto_rotations_resolves_from_geometry():
+    """window_rotations=0 = auto: concepts-per-window + 1, clamped [1, 8];
+    explicit depths pass through; no geometry or sequential engine -> 1."""
+    from distributed_drift_detection_tpu import RunConfig
+    from distributed_drift_detection_tpu.config import auto_rotations
+
+    auto = RunConfig(window_rotations=0, window=64, per_batch=100, partitions=16)
+    # headline-like: concept_pp = 51200/16 = 3200, window covers 6400 -> 3
+    assert auto_rotations(auto, 51_200) == 3
+    assert auto_rotations(auto, 1 << 30) == 1  # window ≪ concept: stay at 1
+    assert auto_rotations(auto, 100) == 8  # tiny concepts: clamped at 8
+    assert auto_rotations(auto, 0) == 1  # no planted geometry
+    seq = RunConfig(window_rotations=0, window=1)
+    assert auto_rotations(seq, 51_200) == 1  # sequential engine
+    explicit = RunConfig(window_rotations=5)
+    assert auto_rotations(explicit, 51_200) == 5
+
+    # api.prepare applies the resolution (and the runner accepts it).
+    import numpy as np
+
+    from distributed_drift_detection_tpu.api import prepare
+    from distributed_drift_detection_tpu.io.stream import synthesize_stream
+
+    rng = np.random.default_rng(0)
+    y0 = (np.arange(512) * 4 // 512).astype(np.int64)
+    X0 = rng.normal(size=(512, 8)).astype(np.float32)
+    stream = synthesize_stream(X0, y0, mult_data=16, seed=0)  # dist 2048
+    prep = prepare(
+        RunConfig(
+            dataset="<mem>", partitions=16, per_batch=4, window=64,
+            window_rotations=0, results_csv="",
+        ),
+        stream,
+    )
+    # concept_pp = 128, window covers 256 elements -> ceil(2)+1 = 3
+    assert prep.config.window_rotations == 3
