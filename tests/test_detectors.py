@@ -7,6 +7,8 @@ with it, and the engines must accept the kernels through the ``detector=``
 seam end to end.
 """
 
+import functools
+
 import numpy as np
 import pytest
 
@@ -356,9 +358,18 @@ def test_api_detects_planted_drifts(detector, window):
     assert (delay <= 2 * res.config.per_batch * res.config.partitions).all()
 
 
+@functools.lru_cache(maxsize=None)
+def _sequential_flags(detector):
+    return _api_run(detector, window=1).flags
+
+
+@pytest.mark.parametrize("rotations", [1, 3])
 @pytest.mark.parametrize("detector", ["ph", "eddm"])
-def test_window_engine_matches_sequential(detector):
-    a = _api_run(detector, window=1)
-    b = _api_run(detector, window=8)
-    for fa, fb in zip(a.flags, b.flags):
+def test_window_engine_matches_sequential(detector, rotations):
+    """Window engine == sequential for the zoo members too, at both
+    speculation depths (the level loop resets *any* DetectorKernel's state
+    via det.init(), not just DDM's)."""
+    a = _sequential_flags(detector)
+    b = _api_run(detector, window=8, window_rotations=rotations)
+    for fa, fb in zip(a, b.flags):
         np.testing.assert_array_equal(fa, fb)
