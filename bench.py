@@ -232,7 +232,15 @@ def main() -> None:
     mult = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     window = int(sys.argv[3]) if len(sys.argv) > 3 else 64
-    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    # Default speculation depth 0 = auto (config.auto_rotations → 3 at the
+    # headline geometry: a 64-batch window spans 2 concepts, so depth 3
+    # commits a whole window per sequential step even when both planted
+    # boundaries fire — cutting the detect phase's iteration count from
+    # ≈ NB/W + drifts (~59) to ≈ NB/W (~20-26). Per-level device work at
+    # these shapes is ~10 MFLOP (trivial), so even a fully compute-bound
+    # regime roughly breaks even while the observed dispatch-latency-bound
+    # regime wins ~linearly in saved iterations.
+    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     cfg = RunConfig(
         dataset="/root/reference/outdoorStream.csv",
         mult_data=mult,
