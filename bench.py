@@ -231,27 +231,30 @@ def main() -> None:
     # the speculative engine's knobs for on-hardware sweeps via this CLI.
     mult = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    window = int(sys.argv[3]) if len(sys.argv) > 3 else 64
-    # Default speculation depth 0 = auto (config.auto_rotations → 3 at the
-    # headline geometry: a 64-batch window spans 2 concepts, so depth 3
-    # commits a whole window per sequential step even when both planted
-    # boundaries fire — cutting the detect phase's iteration count from
-    # ≈ NB/W + drifts (~59) to ≈ NB/W (~20-26). Per-level device work at
-    # these shapes is ~10 MFLOP (trivial), so even a fully compute-bound
-    # regime roughly breaks even while the observed dispatch-latency-bound
-    # regime wins ~linearly in saved iterations.
-    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    # (window, rotations) = (128, 4): the measured optimum of the r03 W×R
+    # sweep on one TPU chip (detect-phase medians of 7, uncontended
+    # conditions, flags bit-identical across all configs):
+    #
+    #   W=64  R=1: 0.165 s   (round-2 default)
+    #   W=64  R=4: 0.161 s   W=64  R=8: 0.199 s
+    #   W=128 R=1: 0.218 s   (wide window without rotations: replay waste)
+    #   W=128 R=2: 0.176 s   W=128 R=3: 0.161 s
+    #   W=128 R=4: 0.156 s   ← best    W=128 R=5: 0.159 s (= auto's pick)
+    #   W=192 R=4: 0.191 s   W=256 R=5: 0.212 s (per-iteration slice cost)
+    #
+    # Depth 4 commits a whole 128-batch window (4 planted boundaries at the
+    # headline geometry) per sequential step: iterations ≈ NB/W + drifts/R
+    # ≈ 10 + 10 vs the round-2 default's ≈ 20 + 39. Under the shared
+    # tunnel's contended conditions (per-iteration cost 3-5× higher) the
+    # iteration-count reduction is worth proportionally more.
+    window = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 4
     cfg = RunConfig(
         dataset="/root/reference/outdoorStream.csv",
         mult_data=mult,
         partitions=partitions,
         per_batch=100,
         model="centroid",  # closed-form fit; the RF-equivalent flagship
-        # Wider speculation than the default 16: at the headline geometry
-        # (concept spacing 32 batches/partition) the sequential while-loop
-        # iteration count, not per-step FLOPs, bounds the detect phase, and
-        # measured medians improve monotonically up to the clamp (W=64
-        # ≈ 0.50 s vs W=16 ≈ 0.62 s end-to-end at mult=512).
         window=window,
         window_rotations=rotations,
         results_csv="",
