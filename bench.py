@@ -265,10 +265,14 @@ def main() -> None:
     )
 
     # Warm-ups: compile once on the real shapes, then once more to flush any
-    # remaining one-time device/tunnel setup out of the timed region.
+    # remaining one-time device/tunnel setup out of the timed region — the
+    # flag fetch included: the first device→host transfer of the packed
+    # table pays multi-second one-time setup over the remote-TPU link, and
+    # without fetching here it lands in timed repetition 1's collect phase
+    # (both r03 captures recorded a 3.5–6.4 s first-rep collect outlier).
     for _ in range(2):
         db, dk = shard_batches(batches, keys, mesh)
-        jax.block_until_ready(runner(db, dk))
+        np.asarray(runner(db, dk).packed)
 
     # Timed runs — each spans the reference's Final Time
     # (upload + detect + collect + delay metric); report the median of 9
