@@ -703,6 +703,20 @@ def run_soak_chained(
         state, meta = load_checkpoint(checkpoint_path, template)
         got = {k: meta.get(k) for k in geometry}
         if got != geometry:
+            # A genuine geometry difference is the primary diagnosis; only
+            # when geometry matches and solely the fingerprint is absent is
+            # this a legacy (pre-key_fp) checkpoint — whose key is
+            # unknowable, so it cannot be safely resumed under the
+            # fail-loudly-on-seed-change contract.
+            non_key = {k: v for k, v in got.items() if k != "key_fp"}
+            if non_key == {
+                k: v for k, v in geometry.items() if k != "key_fp"
+            } and got.get("key_fp") is None:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} predates the PRNG-key "
+                    "fingerprint field and cannot be verified against this "
+                    "run's key; delete it to restart the chain"
+                )
             raise ValueError(
                 f"checkpoint {checkpoint_path} was written by a different "
                 f"chain geometry ({got} != {geometry}); delete it or match "

@@ -230,6 +230,14 @@ def check_spurious(
         raise ValueError(f"baseline model {baseline!r} not in measured rows")
 
     def rate(s: ParitySummary) -> float:
+        if math.isnan(s.hits) or math.isnan(s.spurious):
+            # summarize() tolerates pre-attribution CSV rows (nan means),
+            # but a rate criterion over them would silently propagate nan
+            # and read as FAIL downstream — demand real columns instead.
+            raise ValueError(
+                f"model {s.model!r} rows lack attribution columns "
+                "(pre-r03 CSV?); regenerate with harness.parity"
+            )
         total = s.hits + s.spurious
         return s.spurious / total if total else 0.0
 

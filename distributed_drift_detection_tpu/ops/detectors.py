@@ -152,9 +152,15 @@ def _validate_ph(params: PHParams) -> None:
 
 
 def ph_step(
-    state: PHState, err: jax.Array, params: PHParams = PHParams()
+    state: PHState, err: jax.Array, params: PHParams
 ) -> tuple[PHState, tuple[jax.Array, jax.Array]]:
-    """One element (executable spec — see module docstring)."""
+    """One element (executable spec — see module docstring).
+
+    ``params`` is required on every PH kernel (here, :func:`ph_batch`,
+    :func:`ph_window`): ``PHParams()``'s threshold default is the 0 = auto
+    sentinel (``config.auto_ph_threshold``), which the kernels reject — a
+    default argument would be a guaranteed ``ValueError``.
+    """
     _validate_ph(params)
     cnt = state.count + 1
     xsum = state.x_sum + err
@@ -201,7 +207,7 @@ def ph_batch(
     state: PHState,
     errs: jax.Array,
     valid: jax.Array,
-    params: PHParams = PHParams(),
+    params: PHParams,
 ) -> tuple[PHState, DDMBatchResult]:
     """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
     end_state, warning, change = _ph_masks(state, errs, valid, params)
@@ -212,7 +218,7 @@ def ph_window(
     state: PHState,
     errs: jax.Array,
     valid: jax.Array,
-    params: PHParams = PHParams(),
+    params: PHParams,
 ) -> tuple[PHState, DDMWindowResult]:
     """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
     w, b = errs.shape

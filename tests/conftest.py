@@ -33,7 +33,9 @@ import pytest  # noqa: E402
 # and excluded by default, keeping the per-change gate (`pytest tests/ -q`)
 # fast. Run the slow tier with `-m slow` (CI runs both tiers as parallel
 # jobs) or everything with `--runslow`. Every slow-marked contract keeps a
-# smaller fast-tier representative in its file.
+# smaller fast-tier representative — in the same file, or (for the
+# subprocess dryrun / multi-process launches) in the sibling single-process
+# suites (test_parallel.py, test_multihost.py) that pin the same seams.
 # ---------------------------------------------------------------------------
 
 

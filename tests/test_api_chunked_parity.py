@@ -11,15 +11,23 @@ from distributed_drift_detection_tpu.io import chunk_stream_arrays, planted_prot
 from distributed_drift_detection_tpu.models import ModelSpec, build_model
 
 
-@pytest.mark.slow
-def test_chunked_matches_api_run_with_host_shuffle():
-    stream = planted_prototypes(2, concepts=6, rows_per_concept=400, features=7)
+@pytest.mark.parametrize(
+    "concepts,rpc",
+    [
+        (3, 160),  # fast-tier representative of the cross-path contract
+        pytest.param(6, 400, marks=pytest.mark.slow),  # full size
+    ],
+)
+def test_chunked_matches_api_run_with_host_shuffle(concepts, rpc):
+    stream = planted_prototypes(2, concepts=concepts, rows_per_concept=rpc,
+                                features=7)
     cfg = RunConfig(
         partitions=4, per_batch=50, model="centroid",
         shuffle_batches=True, results_csv="", seed=3,
     )
     res = run(cfg, stream=stream)
     ref = np.asarray(res.flags.change_global)
+    assert (ref >= 0).any()  # the contract must be detection-bearing
 
     det = ChunkedDetector(
         build_model(cfg.model, ModelSpec(stream.num_features, stream.num_classes), cfg),
