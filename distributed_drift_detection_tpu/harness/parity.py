@@ -225,19 +225,23 @@ def check_spurious(
     percentage points more of its detections on non-first fires than the
     reference's RandomForest family on the same streams.
     """
+    # summarize() tolerates pre-attribution CSV rows (nan means) for the
+    # delay columns, but a rate criterion must not quietly compute over a
+    # different row subset than the delay criterion (mixed CSV) or
+    # propagate nan into a silent FAIL (all-legacy CSV) — demand the
+    # columns on every row.
+    for r in rows:
+        if r.get("hits", "") == "" or r.get("spurious", "") == "":
+            raise ValueError(
+                f"row (model={r.get('model')!r}, seed={r.get('seed')!r}) "
+                "lacks attribution columns (pre-r03 CSV?); regenerate with "
+                "harness.parity"
+            )
     summary = {s.model: s for s in summarize(rows)}
     if baseline not in summary:
         raise ValueError(f"baseline model {baseline!r} not in measured rows")
 
     def rate(s: ParitySummary) -> float:
-        if math.isnan(s.hits) or math.isnan(s.spurious):
-            # summarize() tolerates pre-attribution CSV rows (nan means),
-            # but a rate criterion over them would silently propagate nan
-            # and read as FAIL downstream — demand real columns instead.
-            raise ValueError(
-                f"model {s.model!r} rows lack attribution columns "
-                "(pre-r03 CSV?); regenerate with harness.parity"
-            )
         total = s.hits + s.spurious
         return s.spurious / total if total else 0.0
 

@@ -124,7 +124,11 @@ def attribution_metrics(
         spurious=num_detections - hits,
         precision=hits / num_detections,
         recall=hits / (p * nb),
-        mean_first_hit_delay_rows=float(delays.mean()),
+        # hits == 0 is reachable with detections present (all spurious —
+        # e.g. every fire lands before the first boundary): nan, silently.
+        mean_first_hit_delay_rows=(
+            float(delays.mean()) if hits else float("nan")
+        ),
         first_hit_delays=delays,
     )
 

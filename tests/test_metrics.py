@@ -68,6 +68,50 @@ def test_attribution_empty_and_no_geometry():
     assert a.num_boundaries == 0 and a.spurious == 1 and a.precision == 0.0
 
 
+def test_attribution_matches_bruteforce_oracle_fuzzed():
+    """Vectorised attribution == a per-detection brute-force oracle over
+    randomized tables (duplicates, pre-boundary fires, misses, shuffled
+    column order, ragged stream ends)."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        p = int(rng.integers(1, 6))
+        cols = int(rng.integers(1, 12))
+        dist = int(rng.integers(50, 400))
+        num_rows = int(rng.integers(dist, 6 * dist))
+        table = np.full((p, cols), -1, np.int64)
+        mask = rng.random((p, cols)) < 0.7
+        table[mask] = rng.integers(0, num_rows, size=int(mask.sum()))
+
+        # Brute force: per (partition, boundary>=1) the earliest position.
+        nb = (num_rows - 1) // dist
+        first = {}
+        spurious = 0
+        for q in range(p):
+            for pos in table[q][table[q] >= 0]:
+                m = pos // dist
+                if 1 <= m <= nb:
+                    k = (q, m)
+                    if k not in first or pos < first[k]:
+                        if k in first:
+                            spurious += 1  # displaced later duplicate
+                        first[k] = pos
+                    else:
+                        spurious += 1
+                else:
+                    spurious += 1
+
+        a = attribution_metrics(table, dist, num_rows)
+        n_det = int((table >= 0).sum())
+        assert a.num_boundaries == nb
+        assert a.hits == len(first)
+        assert a.spurious == spurious == n_det - len(first)
+        assert a.misses == p * nb - len(first)
+        np.testing.assert_array_equal(
+            np.sort(a.first_hit_delays),
+            np.sort(np.array([v % dist for v in first.values()], np.int64)),
+        )
+
+
 def test_attribution_agrees_with_delay_metrics_on_clean_table():
     # When every detection is a unique first hit, the attribution delays are
     # exactly delay_metrics' per-detection delays.

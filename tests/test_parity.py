@@ -79,23 +79,40 @@ def test_spurious_criterion_catches_overfiring():
     assert s["sprayer"].spurious == 44.0 and s["rf"].hits == 96.0
 
 
+def _legacy_row(model="rf", seed=0):
+    return {
+        "model": model,
+        "seed": seed,
+        "mean_delay_batches": 50.0,
+        "mean_delay_rows": 5000.0,
+        "detections": 100,
+        "partitions": 8,
+        "per_batch": 100,
+        "mult_data": 4.0,
+        "dataset": "synth:rialto",
+    }
+
+
 def test_summarize_tolerates_legacy_rows_without_attribution():
     """Rows from a pre-attribution CSV still summarize (nan attribution)."""
-    legacy = [
-        {
-            "model": "rf",
-            "seed": 0,
-            "mean_delay_batches": 50.0,
-            "mean_delay_rows": 5000.0,
-            "detections": 100,
-            "partitions": 8,
-            "per_batch": 100,
-            "mult_data": 4.0,
-            "dataset": "synth:rialto",
-        }
-    ]
-    s = summarize(legacy)[0]
+    s = summarize([_legacy_row()])[0]
     assert s.mean == 50.0 and np.isnan(s.hits) and np.isnan(s.first_hit_delay)
+
+
+def test_check_spurious_rejects_rows_without_attribution():
+    """The spurious-rate criterion refuses pre-attribution rows loudly —
+    all-legacy AND mixed CSVs (a mixed file would otherwise compute the
+    rate over a different seed subset than the delay criterion)."""
+    import pytest
+
+    legacy = [_legacy_row("rf", 0), _legacy_row("centroid", 0)]
+    with pytest.raises(ValueError, match="attribution columns"):
+        check_spurious(legacy)
+    mixed = _rows("rf", [50.0]) + [_legacy_row("centroid", 0)]
+    with pytest.raises(ValueError, match="attribution columns"):
+        check_spurious(mixed)
+    # delay criterion still works on the same legacy rows
+    assert check_criterion(legacy)["centroid"] == 0.0
 
 
 @pytest.mark.slow

@@ -300,6 +300,33 @@ def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
             drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
             key=jax.random.key(99),
         )
+    # A checkpoint that predates the key-fingerprint field (same geometry
+    # otherwise) gets the clear predates-field error, not the misleading
+    # generic mismatch; with genuinely different geometry the real
+    # diagnosis still wins.
+    import json as _json
+
+    data = dict(np.load(ckpt, allow_pickle=False))
+    meta = _json.loads(bytes(data["__meta__"]).decode())
+    orig_fp = meta.pop("key_fp")
+
+    def rewrite(m):
+        d = dict(data)
+        d["__meta__"] = np.frombuffer(_json.dumps(m).encode(), dtype=np.uint8)
+        np.savez(ckpt, **d)
+
+    rewrite(meta)
+    with pytest.raises(ValueError, match="predates the PRNG-key"):
+        run_soak_chained(
+            model, partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt,
+        )
+    with pytest.raises(ValueError, match="different[\\s\\S]*geometry"):
+        run_soak_chained(  # legacy AND different drift spacing
+            model, partitions=4, per_batch=100, total_rows=40_000,
+            drift_every=500, max_leg_rows=10_000, checkpoint_path=ckpt,
+        )
+    rewrite({**meta, "key_fp": orig_fp})  # restore for the resume below
     # The matching key (the default key(0)) still resumes fine.
     resumed = run_soak_chained(
         model, partitions=4, per_batch=100, total_rows=40_000,
