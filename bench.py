@@ -86,8 +86,8 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
     ≤ 2^31 rows runs as ONE device program (median of 3 warm repetitions,
     ``reps: 3``) — and, with ``chained_proof``, additionally runs the SAME
     stream as a 2-leg state-carrying chain (``engine.soak.run_soak_chained``,
-    legs forced via ``max_leg_rows``) and asserts its detections and delays
-    equal the one-shot run's exactly, recording the proof as
+    legs forced via ``max_leg_rows``) and asserts its per-partition
+    detection positions equal the one-shot run's exactly, recording the proof as
     ``chained_legs``/``chained_time_s``/``chained_matches`` (the >2³¹
     mechanism, exercised and verified on TPU every round). The chain is run
     first and the one-shot geometry is taken from its leg-aligned row count,
@@ -128,6 +128,19 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
     if chained_proof:
         # 2-leg chain first: its leg-aligned geometry defines the stream
         # both paths run (1e9 requested → 2 × 8300 batches/partition).
+        # The proof below compares *per-partition detection positions*, so
+        # collect them leg by leg (the summary folds flags into global delay
+        # stats; a compensating mismatch — same delays attributed to
+        # different partitions — must not pass, ADVICE r3).
+        chain_pos = [[] for _ in range(p)]
+
+        def _collect_positions(leg_idx, flags):
+            leg_cg = np.asarray(flags.change_global)
+            for q in range(p):
+                hit = leg_cg[q][leg_cg[q] >= 0]
+                if hit.size:
+                    chain_pos[q].append(hit.astype(np.int64))
+
         s = run_soak_chained(
             model,
             partitions=p,
@@ -136,6 +149,7 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
             key=key,
             total_rows=total_rows,
             max_leg_rows=2**29,
+            on_leg=_collect_positions,
         )
         nb = s.rows_processed // (p * b)
         if p * nb * b > 2**31 - 1:
@@ -176,19 +190,33 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
 
     if chained_proof:
         # The exactness contract, proven on this hardware: the 2-leg chain
-        # found the same changes at the same stream positions. A mismatch
-        # raises — in --soak mode that is the error JSON + exit 1; in the
-        # default bench the rider converts it to a soak_error key, so the
-        # artifact can never carry a normal-looking soak block over a broken
-        # >2^31 mechanism.
-        matches = s.detections == detections and np.array_equal(
-            np.sort(np.asarray(s.delays)), np.sort(delays.astype(np.int64))
-        )
+        # found the same changes at the same stream positions, PER PARTITION
+        # (chain rows are partition-local; one-shot rows carry the q·nb·b
+        # partition offset, a multiple of drift_every by leg alignment).
+        # Strictly stronger than the old global delay-multiset check: equal
+        # per-partition position multisets imply equal delay multisets, and
+        # a compensating cross-partition attribution mismatch cannot pass.
+        # A mismatch raises — in --soak mode that is the error JSON +
+        # exit 1; in the default bench the rider converts it to a
+        # soak_error key, so the artifact can never carry a normal-looking
+        # soak block over a broken >2^31 mechanism.
+        matches = s.detections == detections
+        for q in range(p):
+            one = np.sort(
+                cg[q][cg[q] >= 0].astype(np.int64) - q * nb * b
+            )
+            ch = (
+                np.sort(np.concatenate(chain_pos[q]))
+                if chain_pos[q]
+                else np.empty(0, np.int64)
+            )
+            matches = matches and np.array_equal(one, ch)
         if not matches:
             raise RuntimeError(
                 "chained-soak proof FAILED: 2-leg chain found "
                 f"{int(s.detections)} detections vs one-shot {detections} "
-                "(or delay multisets differ) on identical streams"
+                "(or per-partition position multisets differ) on identical "
+                "streams"
             )
         extras["chained_matches"] = True
 

@@ -275,7 +275,16 @@ def auto_ph_threshold(cfg: RunConfig, dist_between_changes: int) -> float:
         return cfg.ph.threshold
     if dist_between_changes <= 0:
         return 50.0
-    concept_pp = dist_between_changes / max(cfg.partitions, 1)
+    return auto_ph_threshold_rows(
+        dist_between_changes / max(cfg.partitions, 1)
+    )
+
+
+def auto_ph_threshold_rows(concept_pp: float) -> float:
+    """The λ auto-resolution formula on a *per-partition* concept length in
+    rows — the config-free core of :func:`auto_ph_threshold`, shared with
+    engines that know their drift geometry directly (``engine.soak``'s
+    ``drift_every`` is exactly this quantity)."""
     return float(min(32.0, max(4.0, concept_pp / 16.0)))
 
 

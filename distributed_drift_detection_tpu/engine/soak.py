@@ -150,6 +150,29 @@ def _mesh_sharding(model: Model, mesh, partitions: int):
     return partition_sharding(mesh, partitions)
 
 
+def resolve_soak_detector(ddm_params: DDMParams, detector, drift_every: int):
+    """Detector for a soak engine: a :class:`DetectorKernel` passes through
+    (``None`` → DDM, exactly ``resolve_detector``); a **name string** is
+    built here, with Page–Hinkley's ``threshold = 0`` auto sentinel resolved
+    from the soak's own drift geometry — ``drift_every`` *is* the
+    per-partition concept length that ``config.auto_ph_threshold`` derives
+    for api streams, so ``detector='ph'`` works out of the box on every soak
+    entry point instead of tripping the kernels' unresolved-λ rejection
+    (``ops.detectors.make_detector``). Non-default parameters still go the
+    explicit route: build the kernel yourself with a concrete λ."""
+    if isinstance(detector, str):
+        from ..config import PHParams, auto_ph_threshold_rows
+        from ..ops.detectors import make_detector
+
+        ph = PHParams()
+        if detector == "ph":
+            ph = ph._replace(
+                threshold=auto_ph_threshold_rows(float(drift_every))
+            )
+        return make_detector(detector, ddm=ddm_params, ph=ph)
+    return resolve_detector(ddm_params, detector)
+
+
 def make_soak_runner(
     model: Model,
     ddm_params: DDMParams = DDMParams(),
@@ -215,7 +238,7 @@ def make_soak_runner(
             f"soak of {p * nb * b:,} rows exceeds the int32 global-row-index "
             "range (2^31-1); use run_soak_chained / make_soak_chain"
         )
-    det = resolve_detector(ddm_params, detector)
+    det = resolve_soak_detector(ddm_params, detector, drift_every)
     if window < 1:
         # window=0 means "auto" framework-wide (config.auto_window); the
         # soak could resolve it from drift_every but a caller wiring
@@ -439,7 +462,7 @@ def _make_soak_chain_impl(
             f"{total_blocks:,} total concept blocks exceed int32 ids; "
             "raise `drift_every` or lower `partitions`"
         )
-    det = resolve_detector(ddm_params, detector)
+    det = resolve_soak_detector(ddm_params, detector, drift_every)
     step = make_partition_step(model, ddm_params, shuffle=False, detector=det)
     # Per-partition concept-block offsets. Passed into the jitted legs as a
     # RUNTIME argument, not baked as a constant: blocks_pp depends on the
@@ -620,7 +643,8 @@ def run_soak_chained(
     them back to back with the carried state, and folds each leg's flag
     table into scalar detection statistics host-side (the full 1e10-row flag
     table is never materialised). ``on_leg(leg_idx, flags)`` is an optional
-    observer. Rounds the row count *up* to a whole number of aligned legs.
+    observer (``flags.change_global`` arrives host-converted — the driver's
+    own d2h is reused, so observers don't pay a second transfer). Rounds the row count *up* to a whole number of aligned legs.
 
     Both leg executables are AOT-compiled (``.lower().compile()``) before
     the measured span — ``exec_time_s`` in the summary covers execution and
@@ -654,6 +678,10 @@ def run_soak_chained(
     # Leg length in batches: smallest multiple of the concept alignment
     # (L·b ≡ 0 mod drift_every ⇔ L ≡ 0 mod de/gcd(de, b)), capped by
     # max_leg_rows.
+    # Resolve once up front (names → kernels, PH auto-λ from drift geometry)
+    # so the legs and the checkpoint-geometry record can't disagree about
+    # the detector's concrete parameters.
+    detector = resolve_soak_detector(ddm_params, detector, de)
     align_b = de // math.gcd(de, b)
     nb_total = max(-(-int(total_rows) // (p * b)), 2)
     L = max(int(max_leg_rows) // (p * b), align_b)
@@ -683,14 +711,13 @@ def run_soak_chained(
     if S > 1:
         next_c = impl.next.lower(state_sh, jnp.int32(0), impl.block0s).compile()
 
-    det = resolve_detector(ddm_params, detector)
     geometry = {
         "p": p, "b": b, "L": L, "S": S, "de": de,
         "generator": generator,
         # Name AND full parameter tuple: shapes alone can't tell a resumed
         # chain that its detector thresholds changed between runs.
-        "detector": det.name,
-        "detector_params": [float(v) for v in det.params],
+        "detector": detector.name,
+        "detector_params": [float(v) for v in detector.params],
         # PRNG key fingerprint (ADVICE r2): a stale checkpoint at the same
         # path must not silently continue a *different* seed's stream —
         # resuming replays the checkpointed carry, so without this a caller
@@ -744,9 +771,12 @@ def run_soak_chained(
         # Observer BEFORE the checkpoint marks the leg complete: a crash
         # inside on_leg re-runs the leg on resume and delivers its flags
         # again (at-least-once; a post-checkpoint crash would silently drop
-        # them, as the checkpoint does not carry flag tables).
+        # them, as the checkpoint does not carry flag tables). change_global
+        # is handed over host-converted (the driver already paid that d2h
+        # for its own folding) so observers reading it don't re-transfer
+        # inside the measured span.
         if on_leg is not None:
-            on_leg(s, out.flags)
+            on_leg(s, out.flags._replace(change_global=cg))
         if checkpoint_path:
             tmp = checkpoint_path + ".tmp"
             save_checkpoint(

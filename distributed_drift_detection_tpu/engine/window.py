@@ -98,9 +98,14 @@ def make_window_span(
     up to ``R`` changes per step and cutting the count toward
     ``≈ NB/W + drifts/R``. Each level adds one predict + one detector
     prefix pass of device work (trivial at these shapes, so the trade is
-    pure win in the latency-bound regime). Flags are bit-identical to the
-    sequential engine for deterministic-fit models regardless of ``R``
-    (tested); key-consuming fits ('mlp', 'rf') draw their fit keys per
+    pure win in the latency-bound regime). With ``shuffle=False`` (host-side
+    shuffling, the api path) flags are bit-identical to the sequential
+    engine for deterministic-fit models regardless of ``R`` (tested); under
+    the in-jit ``shuffle=True`` mode replayed tail rows reuse the level-0
+    permutations while the sequential engine redraws ``k_shuf`` on
+    re-execution, so even deterministic fits vary with ``R`` there (parity
+    is statistical, like any reseeding). Key-consuming fits
+    ('mlp', 'rf') draw their fit keys per
     *level*, so — exactly like the ``window`` width — ``rotations`` is part
     of their seed story ('seed-equivalent, not bit-equal' across different
     values).

@@ -122,7 +122,9 @@ def off_spec_reason(cfg: RunConfig) -> str | None:
                 f"outdoorStream grid caps partitions at 16 (got "
                 f"{cfg.partitions}; Plot Results.ipynb cell 3)"
             )
-    elif name.startswith("rialto") or cfg.dataset == "synth:rialto":
+    elif name.startswith("rialto") or str(cfg.dataset).startswith("synth:rialto"):
+        # prefix, not equality: parameterized synth specs
+        # ('synth:rialto,seed=1', io/stream.py) are the same published grid
         if cfg.mult_data < 1:
             return (
                 f"rialto grid requires mult_data >= 1 (got {cfg.mult_data}; "

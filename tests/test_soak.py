@@ -403,3 +403,48 @@ def test_chained_soak_detector_zoo_matches_one_shot(det_name):
     one = _run(num_batches=40, detector=det)
     chained = _chain_run(legs=4, batches_per_leg=10, detector=det)
     _assert_chain_equals_one_shot(one.flags, chained, 4, 40 * 100)
+
+
+@pytest.mark.parametrize(
+    "det_name",
+    [
+        "ph",  # fast-tier representative: the auto-λ resolution is the point
+        pytest.param("eddm", marks=pytest.mark.slow),
+        pytest.param("ddm", marks=pytest.mark.slow),
+    ],
+)
+def test_soak_accepts_detector_names(det_name):
+    """``detector='ph'`` (a name string) works on every soak entry point:
+    the constructors resolve PH's threshold=0 auto sentinel from their own
+    ``drift_every`` (resolve_soak_detector) instead of tripping the kernels'
+    unresolved-λ rejection — the api.prepare auto-resolution pattern,
+    available to direct engine users too."""
+    from distributed_drift_detection_tpu.config import (
+        DDMParams,
+        auto_ph_threshold_rows,
+    )
+    from distributed_drift_detection_tpu.engine.soak import (
+        resolve_soak_detector,
+        run_soak_chained,
+    )
+
+    out = _run(num_batches=40, detector=det_name)
+    cg = np.asarray(out.flags.change_global)
+    assert (cg >= 0).any(), "name-built detector never fired on planted drift"
+
+    # Same stream through the chained driver: names resolve identically
+    # (one kernel resolved up front serves legs + checkpoint geometry).
+    s = run_soak_chained(
+        build_model("centroid", ModelSpec(8, 8)),
+        partitions=4,
+        per_batch=100,
+        total_rows=4 * 40 * 100,
+        drift_every=1000,
+        max_leg_rows=4 * 10 * 100,
+        detector=det_name,
+    )
+    assert s.detections == int((cg >= 0).sum())
+
+    # The resolved λ is the drift-geometry formula, not the rejected 0.
+    det = resolve_soak_detector(DDMParams(), "ph", 1000)
+    assert det.params.threshold == auto_ph_threshold_rows(1000)
