@@ -272,15 +272,17 @@ def main() -> None:
     # the speculative engine's knobs for on-hardware sweeps via this CLI.
     mult = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    # (window, rotations) = (128, 4): the measured optimum of the r03 W×R
-    # sweep on one TPU chip (detect-phase medians of 7, uncontended
-    # conditions, flags bit-identical across all configs):
+    # Default 0/0 = auto: the bench measures the *shipped* execution policy
+    # (config.auto_window / auto_rotations co-resolve W×R from stream
+    # geometry; at this headline geometry that is 128×4 — the measured
+    # optimum of the r03 W×R sweep on one TPU chip, detect-phase medians of
+    # 7, uncontended conditions, flags bit-identical across all configs):
     #
     #   W=64  R=1: 0.165 s   (round-2 default)
     #   W=64  R=4: 0.161 s   W=64  R=8: 0.199 s
     #   W=128 R=1: 0.218 s   (wide window without rotations: replay waste)
     #   W=128 R=2: 0.176 s   W=128 R=3: 0.161 s
-    #   W=128 R=4: 0.156 s   ← best    W=128 R=5: 0.159 s (= auto's pick)
+    #   W=128 R=4: 0.156 s   ← best    W=128 R=5: 0.159 s
     #   W=192 R=4: 0.191 s   W=256 R=5: 0.212 s (per-iteration slice cost)
     #
     # Depth 4 commits a whole 128-batch window (4 planted boundaries at the
@@ -288,8 +290,8 @@ def main() -> None:
     # ≈ 10 + 10 vs the round-2 default's ≈ 20 + 39. Under the shared
     # tunnel's contended conditions (per-iteration cost 3-5× higher) the
     # iteration-count reduction is worth proportionally more.
-    window = int(sys.argv[3]) if len(sys.argv) > 3 else 128
-    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    window = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    rotations = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     cfg = RunConfig(
         dataset="/root/reference/outdoorStream.csv",
         mult_data=mult,

@@ -143,14 +143,15 @@ class RunConfig:
     # sequential step between drift checks. 1 = faithful batch-per-step scan;
     # >1 commits up to the first in-window change and replays the rest —
     # identical flags for deterministic-fit models (majority/centroid/gnb/linear),
-    # ~window× fewer sequential steps. 16 balances speculation waste
-    # (~1 window per drift) vs step size. 0 = auto: size the window to the
-    # stream's planted drift spacing (one window per per-partition concept,
-    # clamped to [4, 64]; see config.auto_window). Caveat: the key-consuming
+    # ~window× fewer sequential steps. 0 (the default) = auto: co-resolve the
+    # width with ``window_rotations`` from the stream's planted drift spacing
+    # (config.auto_window; at the headline benchmark geometry the resolution
+    # is the measured r03 W×R sweep optimum, 128×4 — see bench.py's sweep
+    # table). Pass an explicit width to pin it. Caveat: the key-consuming
     # 'mlp' fit draws its init keys per *window*, not per batch, so its flags
     # are seed-equivalent but not bit-equal across different window values —
     # pin window=1 for run-to-run bit-reproducibility of 'mlp' experiments.
-    window: int = 16
+    window: int = 0
     # Speculation depth of the window engine (engine.window): how many
     # rotate-and-replay passes one sequential step may commit. 1 = classic
     # single-rotation speculation; R > 1 replays up to R−1 times inside the
@@ -162,9 +163,10 @@ class RunConfig:
     # costs one extra predict + detector pass of device work per step —
     # pure win in the dispatch-latency-bound regimes the window engine
     # exists for, wasted FLOPs where drift is absent (keep 1 there).
-    # 0 = auto: resolve the depth from stream geometry (the concepts one
-    # window spans, +1; config.auto_rotations — the auto_window pattern).
-    window_rotations: int = 1
+    # 0 (the default) = auto: resolve the depth from stream geometry (the
+    # concepts one window spans; config.auto_rotations — co-tuned with
+    # auto_window so the defaults land on the measured W×R optimum).
+    window_rotations: int = 0
     # (Two rejected-by-measurement alternatives are documented in PARITY.md:
     # a `ddm_kernel='pallas'` fused kernel — ~78× slower than the XLA
     # lowering, removed in round 2 ("Pallas post-mortem") — and a
@@ -212,13 +214,24 @@ def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
 
 
 def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:
-    """Resolve ``window == 0`` from stream geometry.
+    """Resolve ``window == 0`` from stream geometry (W of the W×R policy).
 
-    The speculative engine's sequential-step count is ≈ NB/W + drifts, so W
-    gains nothing past the per-partition drift spacing (a window then spans
-    a whole concept and every drift costs its replay regardless). Pick the
-    power of two nearest that spacing, clamped to [4, 64] (tiny windows
-    forfeit the batching win; huge ones waste speculation and VMEM).
+    With the multi-rotation engine the sequential-step count is
+    ≈ NB/W + drifts/R, and the depth auto-resolution (:func:`auto_rotations`)
+    sizes R to the boundaries one window spans — so W gains past the
+    per-partition drift spacing ``bpc`` (in batches) up to roughly
+    ``R*·bpc``, where each step commits ~R* concepts. The r03 on-hardware
+    W×R sweep (table in ``bench.py``) measured the sweet spot at depth
+    R* = 4: at the headline geometry (bpc = 32) W=128 R=4 beat both the
+    single-rotation optimum (W=64 R=1: 0.165 s → 0.156 s) and every wider /
+    deeper cell (W=192 R=4: 0.191 s — per-iteration slice cost; R=8:
+    0.199 s — per-level replay cost). Pick the power of two nearest
+    ``R*·bpc`` (R* from the pinned depth when the user set one), clamped to
+    [4, 128] (tiny windows forfeit the batching win; the cap is where
+    measured slice cost overtakes saved iterations). A pinned depth of 1
+    reduces to the round-2 policy: W ≈ bpc, one concept per window.
+    Streams without planted geometry get 16 (speculation budget without a
+    spacing to size against).
     """
     if cfg.window:
         return cfg.window
@@ -227,8 +240,10 @@ def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:
         return 16
     import math
 
-    w = 1 << (round(math.log2(bpc)) if bpc > 1 else 0)
-    return int(min(64, max(4, w)))
+    depth = 4 if cfg.window_rotations == 0 else max(cfg.window_rotations, 1)
+    target = max(bpc * depth, 1.0)
+    w = 1 << round(math.log2(target))
+    return int(min(128, max(4, w)))
 
 
 def auto_rotations(cfg: RunConfig, dist_between_changes: int) -> int:
@@ -236,15 +251,19 @@ def auto_rotations(cfg: RunConfig, dist_between_changes: int) -> int:
 
     A window of ``W`` batches covers ``W · per_batch`` elements of one
     partition's stream; with planted concepts of ``dist_between_changes /
-    partitions`` elements per partition it spans ≈ ``L/cpp`` boundaries,
-    each costing one replay level. Depth = round(boundaries-per-window) + 1
-    commits a typical window in one step even when every spanned boundary
-    fires, clamped to [1, 8] (beyond ~8 the per-level predict/detector cost
-    rivals the saved iterations at typical shapes). Windows much smaller
-    than a concept round to depth 1 — paying an every-step replay level for
-    a rare boundary-straddling window is a loss. Resolution needs the
-    *resolved* window — call after :func:`auto_window`. Streams without
-    planted geometry keep depth 1 (speculating on absent drift is waste).
+    partitions`` elements per partition it spans ≈ ``per_window/cpp``
+    boundaries, each costing one replay level. Depth =
+    round(boundaries-per-window) commits a typical window's boundaries in
+    one step (the r03 sweep measured this exact point — R=4 at 4
+    boundaries/window — as the optimum, with the +1 safety level R=5
+    ~2% slower), clamped to [1, 8] (beyond ~8 the per-level
+    predict/detector cost rivals the saved iterations at typical shapes).
+    Windows much smaller than a concept round to depth 1 — paying an
+    every-step replay level for a rare boundary-straddling window is a
+    loss. Resolution needs the *resolved* window — call after
+    :func:`auto_window`; at auto W the pair lands on the measured 128×4 at
+    headline geometry (pinned by tests). Streams without planted geometry
+    keep depth 1 (speculating on absent drift is waste).
     """
     if cfg.window_rotations:
         return cfg.window_rotations
@@ -252,7 +271,7 @@ def auto_rotations(cfg: RunConfig, dist_between_changes: int) -> int:
         return 1
     concept_pp = dist_between_changes / max(cfg.partitions, 1)
     per_window = cfg.window * cfg.per_batch
-    return int(min(8, max(1, round(per_window / concept_pp) + 1)))
+    return int(min(8, max(1, round(per_window / concept_pp))))
 
 
 def auto_ph_threshold(cfg: RunConfig, dist_between_changes: int) -> float:

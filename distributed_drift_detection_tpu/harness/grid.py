@@ -69,15 +69,18 @@ def _config_key(cfg: RunConfig) -> str:
         if cfg.retrain_error_threshold is not None  # 0.0 is an active setting
         else ""
     )
-    # Key-consuming fits (mlp, rf) draw PRNG keys per window/level, so their
-    # flags depend on the window width and speculation depth (config.py's
-    # 'seed-equivalent but not bit-equal' caveat); deterministic fits are
-    # invariant to both (tested), so their historical keys stay stable — as
-    # do rotations=1 keys (the suffix only appears at non-default depth).
-    win = ""
-    if cfg.model in ("mlp", "rf"):
-        rot = f"r{cfg.window_rotations}" if cfg.window_rotations != 1 else ""
-        win = f"-w{cfg.window}{rot}"
+    # The execution policy is part of every trial's identity: window and
+    # speculation depth change the recorded Final Time for every model (the
+    # grid's primary result column) and additionally the flags for
+    # key-consuming fits (mlp/rf draw PRNG keys per window/level —
+    # config.py's 'seed-equivalent but not bit-equal' caveat). 0 = auto is a
+    # well-defined policy version given the other key fields (the
+    # resolution is a pure function of dataset geometry × partitions ×
+    # per_batch, and the dataset prefixes the app name), and keying the raw
+    # values means a *policy change* (e.g. the r04 default move 16×1 →
+    # auto) retires old-policy rows instead of silently resuming onto their
+    # timings — the exact hazard this docstring warns about.
+    win = f"-w{cfg.window}r{cfg.window_rotations}"
     # The detector segment carries the active statistic's name + full
     # parameter tuple. The default DDM keeps the historical key shape
     # (``-ddm<min>_<warn>_<out>``) so existing results CSVs still resume;
@@ -92,7 +95,7 @@ def _config_key(cfg: RunConfig) -> str:
         )
     return (
         f"m{cfg.mult_data}-p{cfg.partitions}-{cfg.model}-b{cfg.per_batch}"
-        f"-{det}-s{cfg.seed}{thr}"
+        f"{win}-{det}-s{cfg.seed}{thr}"
     )
 
 

@@ -101,10 +101,13 @@ def test_trace_dir_writes_profile(tmp_path):
 
 
 def test_auto_window_resolves_from_stream_geometry(tmp_path):
-    """window=0 sizes the speculative window to the planted drift spacing
-    and records the resolved value in the result config."""
+    """window=0 (the default) co-resolves the W×R policy from the planted
+    drift spacing and records the resolved values in the result config."""
     res = run(base_cfg(tmp_path, mult_data=8, partitions=8, model="centroid",
                        results_csv="", window=0))
-    # outdoorStream ×8: dist=800 rows; 8 partitions × per_batch 50 → bpc=2 → 4
-    assert res.config.window == 4
+    # outdoorStream ×8: dist=800 rows; 8 partitions × per_batch 50 → bpc=2;
+    # auto depth targets R*=4 concepts per window → W = 4·2 = 8, and the
+    # depth resolution then lands on the 4 boundaries one window spans.
+    assert res.config.window == 8
+    assert res.config.window_rotations == 4
     assert res.metrics.num_detections > 0

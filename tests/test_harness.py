@@ -157,6 +157,34 @@ def test_grid_detector_sweep_distinct_keys(tmp_path):
     assert n3 == 1
 
 
+def test_grid_key_carries_execution_policy(tmp_path):
+    """The W×R execution policy is part of every trial key: it changes the
+    recorded Final Time for every model (and mlp/rf flags), so a policy
+    change must retire old rows rather than silently resume onto their
+    timings (the r04 default move 16×1 → auto made this live)."""
+    from distributed_drift_detection_tpu.config import replace
+    from distributed_drift_detection_tpu.harness.grid import _config_key
+
+    base = base_cfg(tmp_path)
+    k_auto = _config_key(base)  # defaults: window=0, rotations=0
+    assert "-w0r0-" in k_auto
+    k_pinned = _config_key(replace(base, window=16, window_rotations=1))
+    assert "-w16r1-" in k_pinned and k_auto != k_pinned
+
+    # Live resume semantics: trials recorded under one policy don't satisfy
+    # a sweep under another.
+    n1 = run_grid(base, mults=[1], partitions=[1], trials=1,
+                  progress=lambda *_: None)
+    assert n1 == 1
+    n2 = run_grid(base, mults=[1], partitions=[1], trials=1,
+                  progress=lambda *_: None)
+    assert n2 == 0  # same policy: resumed
+    n3 = run_grid(replace(base, window=16, window_rotations=1),
+                  mults=[1], partitions=[1], trials=1,
+                  progress=lambda *_: None)
+    assert n3 == 1  # changed policy: re-run
+
+
 def test_aggregate_and_tables(tmp_path):
     base = base_cfg(tmp_path)
     run_grid(base, mults=[1, 2], partitions=[1, 2], trials=2, progress=lambda *_: None)
