@@ -82,11 +82,22 @@ class PHParams(NamedTuple):
 
 class EDDMParams(NamedTuple):
     """EDDM hyper-parameters (detector='eddm', ops/detectors.py;
-    Baena-García et al. 2006 defaults)."""
+    Baena-García et al. 2006 defaults).
+
+    ``paper_exact`` selects the distance semantics for the first error after
+    init/reset: ``False`` (default) keeps the framework's documented
+    deviation — one uniform ``d = t − last_err_t`` recurrence whose first
+    post-reset error contributes a synthetic distance measured from the
+    reset; ``True`` is Baena-García 2006 exactly — the first error merely
+    arms the distance origin and ``min_num_errors`` counts *distances*.
+    The deviation is quality-neutral but not flag-neutral (measured numbers
+    in PARITY.md "EDDM deviation"), so paper-comparable runs should set
+    ``True``; the default preserves the framework's historical flags."""
 
     min_num_errors: int = 30
     warning_alpha: float = 0.95
     change_beta: float = 0.9
+    paper_exact: bool = False
 
 
 # Valid RunConfig.detector values (kernels in ops/detectors.py). Lives here,
@@ -211,6 +222,15 @@ class RunConfig:
 
 def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
     return dataclasses.replace(cfg, **kw)
+
+
+# Version of the auto W×R resolution policy (auto_window / auto_rotations).
+# Bump whenever the resolution *algorithm* changes (v2 = the r04 co-resolved
+# depth-4 policy): grid trial keys embed it for auto-mode configs
+# (harness.grid._config_key), so trials recorded under an older policy are
+# retired on re-run instead of silently resumed onto stale-policy timings —
+# '-w0r0' alone names the sentinel, not what it resolves to.
+AUTO_POLICY_VERSION = 2
 
 
 def auto_window(cfg: RunConfig, dist_between_changes: int) -> int:

@@ -37,7 +37,9 @@ def load_runs(results_csv: str) -> pd.DataFrame:
         if col not in df.columns:
             df[col] = "-"
     for col in ("Final Time", "Average Distance", "Data Multiplier",
-                "Rows", "Rows Per Sec"):
+                "Rows", "Rows Per Sec", "Hits", "Spurious", "Recall"):
+        # errors="coerce": the attribution cells carry "-" when a run had no
+        # planted-boundary geometry to attribute against.
         if col in df.columns:
             df[col] = pd.to_numeric(df[col], errors="coerce")
     return df
@@ -54,6 +56,14 @@ def aggregate(df: pd.DataFrame) -> pd.DataFrame:
     )
     if "Rows Per Sec" in df.columns:
         spec["mean_rows_per_sec"] = ("Rows Per Sec", "mean")
+    if "Recall" in df.columns:
+        # The quality axes (C11 schema extension): per-config mean recall /
+        # hits / spurious over trials — the merge contract ("every device
+        # finds the same changes") demonstrated numerically in the grid
+        # study, like the delay-parity artifact does per model family.
+        spec["mean_recall"] = ("Recall", "mean")
+        spec["mean_hits"] = ("Hits", "mean")
+        spec["mean_spurious"] = ("Spurious", "mean")
     if "Rows" in df.columns:
         # Stream length (constant across a config's trials): lets the delay-%
         # figures normalise by the actual row count instead of the legacy

@@ -25,7 +25,12 @@ import os
 import sys
 from collections import Counter
 
-from ..config import DETECTOR_NAMES, RunConfig, replace
+from ..config import (
+    AUTO_POLICY_VERSION,
+    DETECTOR_NAMES,
+    RunConfig,
+    replace,
+)
 from ..results import read_results
 
 
@@ -73,14 +78,17 @@ def _config_key(cfg: RunConfig) -> str:
     # speculation depth change the recorded Final Time for every model (the
     # grid's primary result column) and additionally the flags for
     # key-consuming fits (mlp/rf draw PRNG keys per window/level —
-    # config.py's 'seed-equivalent but not bit-equal' caveat). 0 = auto is a
-    # well-defined policy version given the other key fields (the
-    # resolution is a pure function of dataset geometry × partitions ×
-    # per_batch, and the dataset prefixes the app name), and keying the raw
-    # values means a *policy change* (e.g. the r04 default move 16×1 →
-    # auto) retires old-policy rows instead of silently resuming onto their
-    # timings — the exact hazard this docstring warns about.
+    # config.py's 'seed-equivalent but not bit-equal' caveat). Keying the
+    # raw values means a *default change* (e.g. the r04 move 16×1 → auto)
+    # retires old rows instead of silently resuming onto their timings —
+    # the exact hazard this docstring warns about. Auto-mode keys (0
+    # sentinels) additionally embed config.AUTO_POLICY_VERSION, because
+    # '0' names the sentinel, not what it resolves to: a change to the
+    # resolution *algorithm* must retire auto-mode rows too. Explicit pins
+    # are self-describing and stay unversioned.
     win = f"-w{cfg.window}r{cfg.window_rotations}"
+    if cfg.window == 0 or cfg.window_rotations == 0:
+        win += f"v{AUTO_POLICY_VERSION}"
     # The detector segment carries the active statistic's name + full
     # parameter tuple. The default DDM keeps the historical key shape
     # (``-ddm<min>_<warn>_<out>``) so existing results CSVs still resume;

@@ -135,7 +135,10 @@ def attribution_metrics(
 
 # Reference C11 column schema (``DDM_Process.py:272``), kept verbatim so the
 # notebook-style aggregation (C13-C15) ports unchanged; extended with
-# throughput columns. "Spark Address" carries the backend string here.
+# throughput columns and the boundary-attribution quality axes (Hits /
+# Spurious / Recall — the merge contract "every device finds the same
+# changes", ``DDM_Process.py:89-92``, as a number per run, not only in the
+# delay-parity artifact). "Spark Address" carries the backend string here.
 RESULT_COLUMNS = [
     "Spark App",
     "Exp Start Time",
@@ -153,12 +156,22 @@ RESULT_COLUMNS = [
     "Detections",
     "Model",
     "Detector",
+    "Hits",
+    "Spurious",
+    "Recall",
 ]
 
 
 def result_row(
-    cfg: Any, total_time: float, metrics: DelayMetrics, num_rows: int
+    cfg: Any,
+    total_time: float,
+    metrics: DelayMetrics,
+    num_rows: int,
+    attribution: AttributionMetrics | None = None,
 ) -> list:
+    """One results-CSV row. ``attribution`` is optional so callers without
+    planted-boundary geometry still record the reference columns; absent, the
+    quality cells carry the CSV placeholder."""
     import os
 
     return [
@@ -178,4 +191,7 @@ def result_row(
         metrics.num_detections,
         cfg.model,
         cfg.detector,
+        attribution.hits if attribution else "-",
+        attribution.spurious if attribution else "-",
+        attribution.recall if attribution else "-",
     ]

@@ -58,8 +58,8 @@ def append_result(path: str, row: list) -> None:
                     warnings.warn(
                         f"results CSV {path!r} predates column(s) "
                         f"{dropped}; dropping "
-                        f"{ {c: by_name[c] for c in dropped} } from this row "
-                        "— start a fresh CSV to keep them",
+                        f"{ {c: by_name.get(c, '-') for c in dropped} } "
+                        "from this row — start a fresh CSV to keep them",
                         stacklevel=2,
                     )
                 row = [by_name.get(col, "-") for col in existing]

@@ -115,14 +115,17 @@ def test_append_projects_rows_onto_legacy_header(tmp_path):
     from distributed_drift_detection_tpu.results import append_result
 
     path = str(tmp_path / "legacy.csv")
-    legacy_cols = RESULT_COLUMNS[:-2]  # pre-Model/Detector schema
+    # pre-Model/Detector schema (also predates the Hits/Spurious/Recall
+    # quality axes)
+    legacy_cols = RESULT_COLUMNS[: RESULT_COLUMNS.index("Model")]
     with open(path, "w", newline="") as fh:
         w = _csv.writer(fh)
         w.writerow(legacy_cols)
         w.writerow(["old", "t", "u", 1, 1.0, "-", 0, 0.5, 1.0, "d",
                     100, 1000, 2000.0, 3])
     append_result(path, ["new", "t", "u", 2, 2.0, "-", 0, 0.7, 2.0, "d",
-                         100, 2000, 3000.0, 5, "centroid", "ph"])
+                         100, 2000, 3000.0, 5, "centroid", "ph",
+                         4, 1, 0.8])
     with open(path, newline="") as fh:
         rows = list(_csv.reader(fh))
     assert rows[0] == legacy_cols
@@ -157,6 +160,26 @@ def test_grid_detector_sweep_distinct_keys(tmp_path):
     assert n3 == 1
 
 
+def test_results_carry_attribution_columns(tmp_path):
+    """Every run row records the quality axes (Hits/Spurious/Recall — the
+    C11 schema extension), and the aggregator carries per-config means so
+    the grid study demonstrates the merge contract numerically."""
+    base = base_cfg(tmp_path)
+    run_grid(base, mults=[4], partitions=[2], trials=2,
+             progress=lambda *_: None)
+    rows = read_results(base.results_csv)
+    assert {"Hits", "Spurious", "Recall"} <= set(rows[0])
+    # outdoorStream ×4: 3 interior boundaries × 2 partitions; majority-class
+    # fires on every boundary at this geometry.
+    for r in rows:
+        assert int(r["Hits"]) + int(r["Spurious"]) == int(r["Detections"])
+        assert 0.0 <= float(r["Recall"]) <= 1.0
+    agg = aggregate(load_runs(base.results_csv))
+    assert {"mean_recall", "mean_hits", "mean_spurious"} <= set(agg.columns)
+    assert np.isfinite(agg["mean_recall"]).all()
+    assert (agg["mean_recall"] > 0).all()
+
+
 def test_grid_key_carries_execution_policy(tmp_path):
     """The W×R execution policy is part of every trial key: it changes the
     recorded Final Time for every model (and mlp/rf flags), so a policy
@@ -165,9 +188,13 @@ def test_grid_key_carries_execution_policy(tmp_path):
     from distributed_drift_detection_tpu.config import replace
     from distributed_drift_detection_tpu.harness.grid import _config_key
 
+    from distributed_drift_detection_tpu.config import AUTO_POLICY_VERSION
+
     base = base_cfg(tmp_path)
     k_auto = _config_key(base)  # defaults: window=0, rotations=0
-    assert "-w0r0-" in k_auto
+    # auto-mode keys carry the resolution-policy version ('0' names the
+    # sentinel, not what it resolves to); explicit pins are unversioned
+    assert f"-w0r0v{AUTO_POLICY_VERSION}-" in k_auto
     k_pinned = _config_key(replace(base, window=16, window_rotations=1))
     assert "-w16r1-" in k_pinned and k_auto != k_pinned
 
@@ -232,11 +259,14 @@ def test_render_all_legacy_rows_get_readable_suffix(tmp_path):
     # Modern rows + the same rows as legacy-backfilled placeholders ("-"
     # Model/Detector) in one CSV → two combos, so figures get suffixed.
     combined = str(tmp_path / "combined.csv")
+    im, idt = RESULT_COLUMNS.index("Model"), RESULT_COLUMNS.index("Detector")
     with open(combined, "w", newline="") as fh:
         w = csv.writer(fh)
         w.writerows(rows)
         for r in rows[1:]:
-            w.writerow(r[: len(RESULT_COLUMNS) - 2] + ["-", "-"])
+            masked = list(r)
+            masked[im] = masked[idt] = "-"
+            w.writerow(masked)
     artifacts = render_all(combined, str(tmp_path / "figs2"))
     suffixed = [k for k in artifacts if "legacy" in k]
     assert suffixed, f"no legacy-suffixed figures in {sorted(artifacts)}"
