@@ -25,17 +25,23 @@ uniform :class:`DetectorKernel` seam the engines consume:
   maximum and once ``k ≥ min_num_errors``: warning when ``m2s_k/m2s_max <
   α``, change when ``< β`` (shrinking error distances ⇒ drift).
 
-  **Documented deviation from Baena-García 2006:** the first error after
-  init/reset contributes a distance measured from the stream/reset start
-  (``d = t`` with ``last_err_t = 0``), whereas the paper only measures
-  distances *between consecutive* errors (the first error would merely arm
-  ``last_err_t``). This seeds the mean/std/``m2s_max`` with one synthetic
-  distance per reset. It is deliberate: in the engines' DDM-loop usage the
-  detector is reset at every drift and errors are frequent, so the synthetic
-  distance is small and the ``min_num_errors = 30`` warm-up absorbs it; in
-  exchange every code path (scalar step, batch prefix pass, window pass, and
-  the NumPy test oracle) shares the one uniform ``d = t − last_err_t``
-  recurrence with no seen-an-error flag threaded through the carry.
+  **Documented deviation from Baena-García 2006 (default mode):** the first
+  error after init/reset contributes a distance measured from the
+  stream/reset start (``d = t`` with ``last_err_t = 0``), whereas the paper
+  only measures distances *between consecutive* errors (the first error
+  would merely arm ``last_err_t``). This seeds the mean/std/``m2s_max``
+  with one synthetic distance per reset, in exchange for one uniform
+  ``d = t − last_err_t`` recurrence across every code path. The effect is
+  **measured**, not argued (r04; methodology + numbers in PARITY.md "EDDM
+  deviation", test ``test_eddm_deviation_quantified``): at benchmark-like
+  geometry the two variants are quality-equivalent (boundary recall 99.7%
+  vs 99.5%, spurious within ~4.5%) but not flag-equivalent (detection
+  positions drift by a median ~20 elements via compounding reset-phase
+  shifts). ``EDDMParams(paper_exact=True)`` therefore selects the
+  paper-exact semantics — same state layout, the first post-reset error
+  merely arms the origin and ``min_num_errors`` counts distances — for
+  paper-comparable runs; the default preserves the framework's historical
+  flags.
 
 Both are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
@@ -263,20 +269,31 @@ def eddm_init() -> EDDMState:
 def eddm_step(
     state: EDDMState, err: jax.Array, params: EDDMParams = EDDMParams()
 ) -> tuple[EDDMState, tuple[jax.Array, jax.Array]]:
-    """One element (executable spec — see module docstring)."""
+    """One element (executable spec — see module docstring).
+
+    ``params.paper_exact`` is a trace-time constant selecting whether the
+    first error since init/reset *contributes* a distance (the framework's
+    uniform recurrence) or merely arms the distance origin (Baena-García
+    2006). ``last_err_t > 0`` already encodes "an error has been seen", so
+    both modes share one state layout and one recurrence — exact mode just
+    masks the first contribution.
+    """
     t = state.count + 1
     is_err = err >= 0.5
-    k = state.num_errors + is_err.astype(jnp.int32)
+    contributes = (
+        is_err & (state.last_err_t > 0) if params.paper_exact else is_err
+    )
+    k = state.num_errors + contributes.astype(jnp.int32)
     d = (t - state.last_err_t).astype(jnp.float32)
-    d_sum = state.d_sum + jnp.where(is_err, d, 0.0)
-    d2_sum = state.d2_sum + jnp.where(is_err, d * d, 0.0)
+    d_sum = state.d_sum + jnp.where(contributes, d, 0.0)
+    d2_sum = state.d2_sum + jnp.where(contributes, d * d, 0.0)
     k_f = jnp.maximum(k, 1).astype(jnp.float32)
     mean = d_sum / k_f
     var = jnp.maximum(0.0, d2_sum / k_f - mean * mean)
     m2s = mean + 2.0 * jnp.sqrt(var)
 
-    update_max = is_err & (m2s > state.m2s_max)
-    check = is_err & ~update_max & (k >= params.min_num_errors)
+    update_max = contributes & (m2s > state.m2s_max)
+    check = contributes & ~update_max & (k >= params.min_num_errors)
     ratio = m2s / jnp.maximum(state.m2s_max, 1e-30)
     change = check & (ratio < params.change_beta)
     warning = check & ~change & (ratio < params.warning_alpha)
@@ -299,7 +316,6 @@ def _eddm_masks(
     v = valid.astype(jnp.int32)
     t = state.count + jnp.cumsum(v)  # i32 [N] element index
     is_err = valid & (errs >= 0.5)
-    k = state.num_errors + jnp.cumsum(is_err.astype(jnp.int32))
 
     # Element index of the previous error, strictly before each position:
     # inclusive cummax of (is_err ? t : -1), shifted right, carry-merged.
@@ -308,8 +324,16 @@ def _eddm_masks(
     excl = jnp.concatenate([jnp.full((1,), -1, jnp.int32), incl[:-1]])
     prev_t = jnp.where(excl > 0, excl, state.last_err_t)
 
+    # paper_exact (trace-time constant): the first error since init/reset —
+    # the one with no prior error anywhere before it (prev_t == 0) — only
+    # arms the distance origin; it contributes no distance, no k count, no
+    # m2s event (Baena-García 2006). Default mode: every error contributes
+    # (the framework's uniform recurrence; first d is synthetic from reset).
+    contributes = is_err & (prev_t > 0) if params.paper_exact else is_err
+    k = state.num_errors + jnp.cumsum(contributes.astype(jnp.int32))
+
     d = (t - prev_t).astype(jnp.float32)
-    d_mask = jnp.where(is_err, d, 0.0)
+    d_mask = jnp.where(contributes, d, 0.0)
     d_sum = state.d_sum + jnp.cumsum(d_mask)
     d2_sum = state.d2_sum + jnp.cumsum(d_mask * d_mask)
     k_f = jnp.maximum(k, 1).astype(jnp.float32)
@@ -317,19 +341,20 @@ def _eddm_masks(
     var = jnp.maximum(0.0, d2_sum / k_f - mean * mean)
     m2s = mean + 2.0 * jnp.sqrt(var)
 
-    # Running max of m2s over error events, merged with the carried max.
-    # The detection at an event uses the max *excluding* that event (an
-    # event that raises the max never also signals — see module docstring).
-    m2s_ev = jnp.where(is_err, m2s, -_INF)
+    # Running max of m2s over contributing error events, merged with the
+    # carried max. The detection at an event uses the max *excluding* that
+    # event (an event that raises the max never also signals — see module
+    # docstring).
+    m2s_ev = jnp.where(contributes, m2s, -_INF)
     ev_cummax = lax.cummax(m2s_ev)
     incl_max = jnp.maximum(ev_cummax, state.m2s_max)
     excl_max = jnp.maximum(
         jnp.concatenate([jnp.full((1,), -_INF), ev_cummax[:-1]]),
         state.m2s_max,
     )
-    update_max = is_err & (m2s > excl_max)
+    update_max = contributes & (m2s > excl_max)
 
-    check = is_err & ~update_max & (k >= params.min_num_errors)
+    check = contributes & ~update_max & (k >= params.min_num_errors)
     ratio = m2s / jnp.maximum(excl_max, 1e-30)
     change = check & (ratio < params.change_beta)
     warning = check & ~change & (ratio < params.warning_alpha)
