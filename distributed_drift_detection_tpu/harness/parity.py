@@ -67,7 +67,18 @@ FIELDS = [
     "dataset",
 ]
 
-DEFAULT_MODELS = ("rf", "centroid", "mlp", "linear")
+DEFAULT_MODELS = ("rf", "centroid", "gnb", "mlp", "linear")
+
+# The two benchmark geometries of the committed artifact (VERDICT r3 #3/#4:
+# parity must hold on the reference's *primary published dataset*, not only
+# the rialto stand-in). outdoorStream is consumed from the reference
+# checkout (PARITY.md C16); mult=64 is the smallest on-spec cell of the
+# notebook grid (harness.grid.off_spec_reason), p=8 keeps the CPU-mesh
+# provenance of the artifact. Format: (dataset, mult_data, partitions).
+DEFAULT_GEOMETRIES = (
+    ("synth:rialto", 4.0, 8),
+    ("/root/reference/outdoorStream.csv", 64.0, 8),
+)
 
 # Acceptance bound on spurious-rate inflation vs the rf baseline
 # (check_spurious): at most 15 percentage points more of a model's
@@ -146,6 +157,24 @@ def measure_delay_parity(
                     f"{a.spurious} spurious, recall={a.recall:.3f}"
                 )
     return rows
+
+
+def group_by_geometry(rows: list[dict]) -> dict[tuple, list[dict]]:
+    """Split measured rows by stream geometry (dataset, mult, partitions,
+    per_batch). The acceptance criteria compare models *on the same
+    streams*; a multi-geometry CSV (the committed artifact carries both
+    benchmark geometries) must never pool a model's rialto rows against
+    rf's outdoorStream rows."""
+    out: dict[tuple, list[dict]] = {}
+    for r in rows:
+        key = (
+            str(r["dataset"]),
+            float(r["mult_data"]),
+            int(r["partitions"]),
+            int(r["per_batch"]),
+        )
+        out.setdefault(key, []).append(r)
+    return out
 
 
 class ParitySummary(NamedTuple):
@@ -259,11 +288,79 @@ def write_csv(rows: list[dict], path: str) -> None:
         w.writerows(rows)
 
 
+def report(
+    rows: list[dict], progress=print, required: tuple = ("centroid",)
+) -> bool:
+    """Per-geometry summary table + both acceptance criteria; returns True
+    when every ``required`` model passes both axes in every geometry that
+    has the rf baseline. Only the flagship gates the verdict by default:
+    the sweep deliberately measures families with *documented* domain
+    failures (linear over-fires on rialto-like regimes; gnb cannot separate
+    the rialto stand-in at all — PARITY.md), and an artifact regeneration
+    that honestly records them must not report failure for doing so."""
+    all_ok = True
+    for key, grp in group_by_geometry(rows).items():
+        dataset, mult, partitions, _ = key
+        progress(f"\n=== {dataset} ×{mult:g}, {partitions} partitions ===")
+        progress(
+            f"{'Model':<10} {'mean delay':>14} {'first-hit':>10} "
+            f"{'detections':>11} {'hits':>6} {'spurious':>8} {'recall':>7}"
+        )
+        for s in summarize(grp):
+            progress(
+                f"{s.model:<10} {s.mean:>8.1f} ± {s.std:<4.1f} "
+                f"{s.first_hit_delay:>10.1f} {s.detections:>11.0f} "
+                f"{s.hits:>6.0f} {s.spurious:>8.0f} {s.recall:>7.3f}"
+            )
+        if "rf" not in {r["model"] for r in grp}:
+            progress("(rf baseline not measured — criterion check skipped)")
+            if required:
+                # An unevaluable criterion is not a passed criterion: the
+                # verdict must not be a vacuous True when the baseline is
+                # absent from a geometry.
+                all_ok = False
+            continue
+        spur = check_spurious(grp)
+        gaps = check_criterion(grp)
+        for model, gap in gaps.items():
+            ok_delay = gap <= partitions
+            ok_spur = spur[model] <= SPURIOUS_TOLERANCE
+            if model in required:
+                all_ok = all_ok and ok_delay and ok_spur
+        for m in required:
+            if m not in gaps:  # required model never measured here
+                all_ok = False
+            progress(
+                f"{model}: delay gap vs rf = {gap:+.1f} global batches "
+                f"(criterion ≤ +{partitions}) "
+                f"{'OK' if ok_delay else 'FAIL'}; spurious-rate inflation = "
+                f"{spur[model]:+.3f} (criterion ≤ +{SPURIOUS_TOLERANCE}) "
+                f"{'OK' if ok_spur else 'FAIL'}"
+            )
+    return all_ok
+
+
+def _parse_geometry(spec: str) -> tuple[str, float, int]:
+    """'dataset|mult|partitions' (| because dataset specs may contain both
+    ':' and ',' — e.g. 'synth:rialto,seed=1')."""
+    parts = spec.split("|")
+    if len(parts) != 3:
+        raise ValueError(
+            f"geometry {spec!r} is not 'dataset|mult|partitions'"
+        )
+    return parts[0], float(parts[1]), int(parts[2])
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--dataset", default="synth:rialto")
-    ap.add_argument("--mult", type=float, default=4.0)
-    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument(
+        "--geometry",
+        action="append",
+        default=None,
+        metavar="DATASET|MULT|PARTITIONS",
+        help="a stream geometry to measure (repeatable); default: both "
+        "benchmark geometries (rialto stand-in ×4 and outdoorStream ×64)",
+    )
     ap.add_argument("--per-batch", type=int, default=100)
     ap.add_argument("--seeds", type=int, default=5)
     ap.add_argument("--models", default=",".join(DEFAULT_MODELS))
@@ -278,6 +375,17 @@ def main(argv=None) -> None:
         "friendly for the rf baseline; 'default' uses whatever JAX picks",
     )
     args = ap.parse_args(argv)
+    geometries = (
+        [_parse_geometry(g) for g in args.geometry]
+        if args.geometry
+        else list(DEFAULT_GEOMETRIES)
+    )
+    # Fail fast on a missing dataset file: the expensive geometry runs
+    # first, and a late FileNotFoundError would discard every measured row
+    # (synthetic "synth:..." specs need no file).
+    for ds, _, _ in geometries:
+        if not ds.startswith("synth:") and not os.path.exists(ds):
+            ap.error(f"dataset {ds!r} does not exist")
 
     if args.device == "cpu":
         # A site hook may have initialised an accelerator backend at
@@ -292,9 +400,6 @@ def main(argv=None) -> None:
 
         env = hermetic_cpu_env(8)
         child_argv = [  # rebuilt from parsed args (not filtered raw argv)
-            "--dataset", args.dataset,
-            "--mult", str(args.mult),
-            "--partitions", str(args.partitions),
             "--per-batch", str(args.per_batch),
             "--seeds", str(args.seeds),
             "--models", args.models,
@@ -302,6 +407,8 @@ def main(argv=None) -> None:
             "--out", args.out,
             "--device", "default",
         ]
+        for ds, mult, p in geometries:
+            child_argv += ["--geometry", f"{ds}|{mult}|{p}"]
         raise SystemExit(
             subprocess.call(
                 [
@@ -314,43 +421,25 @@ def main(argv=None) -> None:
             )
         )
 
-    rows = measure_delay_parity(
-        models=args.models.split(","),
-        dataset=args.dataset,
-        mult_data=args.mult,
-        partitions=args.partitions,
-        per_batch=args.per_batch,
-        seeds=range(args.seeds),
-        rf_estimators=args.rf_estimators,
-        progress=print,
-    )
-    write_csv(rows, args.out)
-    print(f"\nwrote {args.out} ({len(rows)} rows)")
-    print(
-        f"{'Model':<10} {'mean delay':>14} {'first-hit':>10} "
-        f"{'detections':>11} {'hits':>6} {'spurious':>8} {'recall':>7}"
-    )
-    for s in summarize(rows):
-        print(
-            f"{s.model:<10} {s.mean:>8.1f} ± {s.std:<4.1f} "
-            f"{s.first_hit_delay:>10.1f} {s.detections:>11.0f} "
-            f"{s.hits:>6.0f} {s.spurious:>8.0f} {s.recall:>7.3f}"
+    rows = []
+    for ds, mult, partitions in geometries:
+        rows += measure_delay_parity(
+            models=args.models.split(","),
+            dataset=ds,
+            mult_data=mult,
+            partitions=partitions,
+            per_batch=args.per_batch,
+            seeds=range(args.seeds),
+            rf_estimators=args.rf_estimators,
+            progress=lambda msg, _ds=ds: print(f"[{_ds}] {msg}"),
         )
-    measured = {r["model"] for r in rows}
-    if "rf" in measured:
-        spur = check_spurious(rows)
-        for model, gap in check_criterion(rows).items():
-            ok_delay = gap <= args.partitions
-            ok_spur = spur[model] <= SPURIOUS_TOLERANCE
-            print(
-                f"{model}: delay gap vs rf = {gap:+.1f} global batches "
-                f"(criterion ≤ +{args.partitions}) "
-                f"{'OK' if ok_delay else 'FAIL'}; spurious-rate inflation = "
-                f"{spur[model]:+.3f} (criterion ≤ +{SPURIOUS_TOLERANCE}) "
-                f"{'OK' if ok_spur else 'FAIL'}"
-            )
-    else:
-        print("(rf baseline not measured — criterion check skipped)")
+        # Incremental write: a crash in a later geometry must not discard
+        # the completed ones' measurements.
+        write_csv(rows, args.out)
+    print(f"\nwrote {args.out} ({len(rows)} rows)")
+    # Exit status carries the acceptance verdict (CI/cron don't scrape
+    # stdout for 'FAIL').
+    raise SystemExit(0 if report(rows) else 1)
 
 
 if __name__ == "__main__":
