@@ -1,0 +1,71 @@
+"""Every ``examples/*.py`` is executed by CI at tiny sizes (VERDICT r3 #7).
+
+The examples are user-facing entry points — the reference's only "docs" are
+runnable scripts (``README.md``), so a broken example is a broken doc. Each
+runs in a hermetic CPU subprocess (the examples bootstrap their own
+``sys.path``), with artifacts landing in the test's tmp dir via ``cwd``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_drift_detection_tpu.utils.hermetic import hermetic_cpu_env
+
+EXAMPLES = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "examples")
+)
+
+
+def run_example(tmp_path, name, *args, devices=4):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), *map(str, args)],
+        env=hermetic_cpu_env(devices),
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed (rc={proc.returncode})\n--- stdout ---\n"
+        f"{proc.stdout[-2000:]}\n--- stderr ---\n{proc.stderr[-4000:]}"
+    )
+    return proc.stdout
+
+
+def test_quickstart_example(tmp_path):
+    out = run_example(tmp_path, "quickstart.py")
+    assert "detections" in out
+    # C11 results row appended in cwd (the example's documented side effect)
+    assert (tmp_path / "ddm_cluster_runs.csv").exists()
+
+
+def test_detector_zoo_example(tmp_path):
+    out = run_example(tmp_path, "detector_zoo.py")
+    for name in ("ddm", "ph", "eddm"):
+        assert name in out, f"detector {name} missing from zoo output:\n{out}"
+
+
+def test_soak_chain_example(tmp_path):
+    out = run_example(tmp_path, "soak_chain.py", 200_000)
+    assert "rows" in out
+
+
+def test_unbounded_stream_example(tmp_path):
+    # 1.2M rows = 3 chunks at the example's geometry, so the mid-stream
+    # checkpoint/resume branch actually executes (half = 1).
+    out = run_example(tmp_path, "unbounded_stream.py", 1_200_000)
+    assert "resumed from checkpoint" in out
+    assert "fed 3 chunks" in out
+
+
+@pytest.mark.slow
+def test_sweep_and_plots_example(tmp_path):
+    """The full C12–C15 methodology script (grid → aggregate → figures):
+    ~100 tiny trials, so slow tier."""
+    run_example(tmp_path, "sweep_and_plots.py")
+    assert (tmp_path / "sweep_runs.csv").exists()
+    figs = tmp_path / "figures"
+    assert figs.exists() and any(figs.iterdir())
