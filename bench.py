@@ -367,19 +367,37 @@ def main() -> None:
         # state-carrying chain can serve — captured at true >2^31 scale on
         # hardware every round (VERDICT r3 #5: rows > 2^31, legs ≥ 3; leg
         # sizing rounds the 3e9 request up to 3 × ~1.07e9-row legs). Its own
-        # try: an xl failure must not take down the soak block above.
-        try:
-            soak_stats.update(
-                {
-                    f"soak_xl_{k}": v
-                    for k, v in _soak_stats(3_000_000_000).items()
-                }
+        # try: an xl failure must not take down the soak block above. Budget
+        # guard: a 1e9 soak rep beyond 30 s signals heavy shared-tunnel
+        # contention (uncontended ≈ 18 s) under which the xl chain would
+        # run for several minutes — skip with provenance instead of risking
+        # the whole bench invocation's budget (the standalone capture lives
+        # in results/soak_xl_r04.json; `python bench.py --soak 3e9` reruns it).
+        soak_t = soak_stats.get("soak_time_s")
+        if soak_t is None:
+            # The 1e9 soak itself failed — that, not contention, is why
+            # there's no xl capture this invocation.
+            soak_stats["soak_xl_skipped"] = (
+                "1e9 soak failed (see soak_error); xl not attempted"
             )
-        except Exception as e:
-            import traceback
+        elif soak_t <= 30.0:
+            try:
+                soak_stats.update(
+                    {
+                        f"soak_xl_{k}": v
+                        for k, v in _soak_stats(3_000_000_000).items()
+                    }
+                )
+            except Exception as e:
+                import traceback
 
-            traceback.print_exc(file=sys.stderr)
-            soak_stats["soak_xl_error"] = f"{type(e).__name__}: {e}"[:300]
+                traceback.print_exc(file=sys.stderr)
+                soak_stats["soak_xl_error"] = f"{type(e).__name__}: {e}"[:300]
+        else:
+            soak_stats["soak_xl_skipped"] = (
+                f"contended tunnel (soak_time_s={soak_t}); see "
+                "results/soak_xl_r04.json or run bench.py --soak 3e9"
+            )
     else:
         soak_stats = {"soak_skipped": "non-TPU device; use --soak explicitly"}
 

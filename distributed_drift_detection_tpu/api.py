@@ -95,7 +95,11 @@ def _cached_runner(
             window=cfg.window,
             packed=indexed,  # compressed stream ships in the packed form
             detector=make_detector(
-                cfg.detector, ddm=cfg.ddm, ph=cfg.ph, eddm=cfg.eddm
+                cfg.detector,
+                ddm=cfg.ddm,
+                ph=cfg.ph,
+                eddm=cfg.eddm,
+                hddm=cfg.hddm,
             ),
             rotations=cfg.window_rotations,
         )
@@ -107,7 +111,7 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.detector, cfg.ph, cfg.eddm, cfg.window_rotations,
+        cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

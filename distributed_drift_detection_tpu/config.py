@@ -100,10 +100,24 @@ class EDDMParams(NamedTuple):
     paper_exact: bool = False
 
 
+class HDDMParams(NamedTuple):
+    """HDDM-A hyper-parameters (detector='hddm', ops/detectors.py;
+    Frías-Blanco et al. 2015 "A-test" defaults).
+
+    Both knobs are *confidences* for Hoeffding bounds — scale-free, so
+    unlike Page–Hinkley's λ they need no per-stream auto-resolution: the
+    bound tightens with sample count automatically. ``drift_confidence``
+    gates detection, ``warning_confidence`` the reported-only warning zone
+    (larger = more sensitive)."""
+
+    drift_confidence: float = 0.001
+    warning_confidence: float = 0.005
+
+
 # Valid RunConfig.detector values (kernels in ops/detectors.py). Lives here,
 # not in ops/, so jax-free consumers (the grid harness CLI) can validate
 # without initialising a backend.
-DETECTOR_NAMES = ("ddm", "ph", "eddm")
+DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,14 +142,16 @@ class RunConfig:
     model: str = "centroid"
 
     # --- detector (reference C6) ---
-    # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' —
-    # the detector zoo, ops/detectors.py. Non-DDM detectors are a framework
-    # extension: the reference only ships DDM, so cross-reference parity
-    # claims (delay tables, oracle goldens) hold for detector='ddm'.
+    # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' |
+    # 'hddm' (HDDM-A, Hoeffding-bound) — the detector zoo,
+    # ops/detectors.py. Non-DDM detectors are a framework extension: the
+    # reference only ships DDM, so cross-reference parity claims (delay
+    # tables, oracle goldens) hold for detector='ddm'.
     detector: str = "ddm"
     ddm: DDMParams = DDMParams()
     ph: PHParams = PHParams()
     eddm: EDDMParams = EDDMParams()
+    hddm: HDDMParams = HDDMParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

@@ -3,7 +3,7 @@
 The reference is a single-detector artifact — its only statistic is
 skmultiflow's ``DDM`` (``DDM_Process.py:133,139``; rebuilt TPU-native in
 ``ops.ddm``). A drift-detection *framework* owes its users the standard
-alternatives, so this module adds two classic error-stream detectors and a
+alternatives, so this module adds three classic error-stream detectors and a
 uniform :class:`DetectorKernel` seam the engines consume:
 
 * **Page–Hinkley** (:func:`ph_batch`) — the clamped CUSUM test (Page 1954;
@@ -43,14 +43,30 @@ uniform :class:`DetectorKernel` seam the engines consume:
   paper-comparable runs; the default preserves the framework's historical
   flags.
 
-Both are implemented exactly like ``ops.ddm_batch``: the whole microbatch
+* **HDDM-A** (:func:`hddm_batch`) — drift detection via Hoeffding's
+  inequality, "A-test" (Frías-Blanco et al. 2015; the moving-average form
+  popularised by skmultiflow's ``HDDM_A``): maintain the stream mean since
+  reset and a stored *cut* — the prefix ``(n_min, c_min)`` minimising the
+  optimistic bound ``mean + ε(n)`` with ``ε(n, δ) = sqrt(ln(1/δ)/2n)`` —
+  and signal change when the whole-stream mean exceeds the cut's mean by
+  the two-sample bound ``sqrt(m/2 · ln(2/δ))``, ``m = (n − n_min)/(n_min
+  n)``. Warnings use the same test at ``warning_confidence``. One-sided
+  (error *increase* — the direction the engines' rotate-on-drift loop
+  consumes); the paper's symmetric decrease test is deliberately not
+  implemented. Both knobs are scale-free confidences, so ``hddm`` needs no
+  per-stream auto-resolution (contrast ``ph``'s λ).
+
+All three are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
 sums for the running statistics and an ``associative_scan`` for the
 sequential part. For Page–Hinkley the recurrence ``m → max(0, α·m + c)`` is
 closed under composition in the family ``m → max(K, A·m + B)``, so the
 per-element maps compose associatively as ``(A, B, K)`` triples. For EDDM
 the between-error distances telescope through prefix sums over error
-events, and the running maximum is an ordinary ``cummax``.
+events, and the running maximum is an ordinary ``cummax``. For HDDM-A the
+stored cut is a running minimum of ``mean + ε(n)`` with the ``(n, c)``
+prefix as payload — the same min-with-payload associative combine as DDM's
+``(p+s)`` minima (``ops.ddm._run_min``).
 
 State-reset protocol matches the engines' DDM contract (``ops.ddm``): the
 *caller* resets on change (the reference discards its detector at
@@ -71,10 +87,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..config import DDMParams, DETECTOR_NAMES, EDDMParams, PHParams
+from ..config import (
+    DDMParams,
+    DETECTOR_NAMES,
+    EDDMParams,
+    HDDMParams,
+    PHParams,
+)
 from .ddm import (
     DDMBatchResult,
     DDMWindowResult,
+    _run_min,
     ddm_batch,
     ddm_init,
     ddm_window,
@@ -396,6 +419,155 @@ def eddm_window(
 
 
 # --------------------------------------------------------------------------
+# HDDM-A
+# --------------------------------------------------------------------------
+
+
+class HDDMState(NamedTuple):
+    """Carried HDDM-A state (scalar leaves; vmap adds axes).
+
+    ``(n_min, c_min)`` is the stored prefix cut — the prefix minimising the
+    optimistic bound ``mean + ε(n)`` — against which later stream means are
+    tested. ``n_min == 0`` means no cut stored yet."""
+
+    count: jax.Array  # i32: elements absorbed since last reset (total_n)
+    err_sum: jax.Array  # f32: sum of error indicators (total_c)
+    n_min: jax.Array  # i32: element count at the stored cut (0 = none)
+    c_min: jax.Array  # f32: error sum at the stored cut
+
+
+def hddm_init() -> HDDMState:
+    return HDDMState(
+        jnp.int32(0), jnp.float32(0.0), jnp.int32(0), jnp.float32(0.0)
+    )
+
+
+def _hddm_eps(n_f: jax.Array, confidence: float) -> jax.Array:
+    """Hoeffding deviation bound ε(n, δ) = sqrt(ln(1/δ) / 2n)."""
+    import math
+
+    return jnp.sqrt(jnp.float32(math.log(1.0 / confidence)) / (2.0 * n_f))
+
+
+def _hddm_bound(n: jax.Array, n_min: jax.Array, confidence: float) -> jax.Array:
+    """Two-sample Hoeffding bound between the stored cut and the whole
+    stream: sqrt(m/2 · ln(2/δ)) with m = (n − n_min) / (n_min · n)."""
+    import math
+
+    n_f = jnp.maximum(n, 1).astype(jnp.float32)
+    nm_f = jnp.maximum(n_min, 1).astype(jnp.float32)
+    m = (n_f - nm_f) / (nm_f * n_f)
+    return jnp.sqrt(
+        jnp.maximum(m, 0.0) / 2.0 * jnp.float32(math.log(2.0 / confidence))
+    )
+
+
+def hddm_step(
+    state: HDDMState, err: jax.Array, params: HDDMParams = HDDMParams()
+) -> tuple[HDDMState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec — see module docstring).
+
+    Update order matches the A-test: the candidate cut (the current prefix)
+    is considered *before* testing, so an element that becomes the new cut
+    never also signals (``n_min == n`` ⇒ no between-sample to test)."""
+    n = state.count + 1
+    c = state.err_sum + err
+    n_f = n.astype(jnp.float32)
+    mean = c / n_f
+    key = mean + _hddm_eps(n_f, params.drift_confidence)
+    nm_f = jnp.maximum(state.n_min, 1).astype(jnp.float32)
+    stored_key = jnp.where(
+        state.n_min > 0,
+        state.c_min / nm_f + _hddm_eps(nm_f, params.drift_confidence),
+        jnp.float32(_INF),
+    )
+    take = key <= stored_key  # later ties win (the DDM minima rule)
+    n_min = jnp.where(take, n, state.n_min)
+    c_min = jnp.where(take, c, state.c_min)
+
+    testable = (n_min > 0) & (n_min < n)
+    diff = mean - c_min / jnp.maximum(n_min, 1).astype(jnp.float32)
+    change = testable & (
+        diff >= _hddm_bound(n, n_min, params.drift_confidence)
+    )
+    warning = (
+        testable
+        & ~change
+        & (diff >= _hddm_bound(n, n_min, params.warning_confidence))
+    )
+    return HDDMState(n, c, n_min, c_min), (warning, change)
+
+
+def _hddm_masks(
+    state: HDDMState, errs: jax.Array, valid: jax.Array, params: HDDMParams
+):
+    """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``.
+
+    The stored cut is a running minimum of ``mean_i + ε(n_i)`` with the
+    ``(n_i, c_i)`` prefix as payload — exactly the DDM minima formulation
+    (``ops.ddm._run_min``), so the whole batch runs as cumsums + one
+    associative scan."""
+    v = valid.astype(jnp.int32)
+    n = state.count + jnp.cumsum(v)
+    c = state.err_sum + jnp.cumsum(errs * valid.astype(errs.dtype))
+    n_f = jnp.maximum(n, 1).astype(jnp.float32)
+    mean = c / n_f
+    key = jnp.where(
+        valid, mean + _hddm_eps(n_f, params.drift_confidence), _INF
+    )
+    # DDM's min-with-payload combine, verbatim — one tie rule, one place.
+    run_key, run_n, run_c = _run_min(key, n, c)
+
+    nm_f = jnp.maximum(state.n_min, 1).astype(jnp.float32)
+    carried_key = jnp.where(
+        state.n_min > 0,
+        state.c_min / nm_f + _hddm_eps(nm_f, params.drift_confidence),
+        jnp.float32(_INF),
+    )
+    use_run = run_key <= carried_key
+    n_min = jnp.where(use_run, run_n, state.n_min)
+    c_min = jnp.where(use_run, run_c, state.c_min)
+
+    testable = valid & (n_min > 0) & (n_min < n)
+    diff = mean - c_min / jnp.maximum(n_min, 1).astype(jnp.float32)
+    change = testable & (
+        diff >= _hddm_bound(n, n_min, params.drift_confidence)
+    )
+    warning = (
+        testable
+        & ~change
+        & (diff >= _hddm_bound(n, n_min, params.warning_confidence))
+    )
+    end_state = HDDMState(n[-1], c[-1], n_min[-1], c_min[-1])
+    return end_state, warning, change
+
+
+def hddm_batch(
+    state: HDDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: HDDMParams = HDDMParams(),
+) -> tuple[HDDMState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _hddm_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def hddm_window(
+    state: HDDMState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: HDDMParams = HDDMParams(),
+) -> tuple[HDDMState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _hddm_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -405,6 +577,7 @@ def make_detector(
     ddm: DDMParams = DDMParams(),
     ph: PHParams = PHParams(),
     eddm: EDDMParams = EDDMParams(),
+    hddm: HDDMParams = HDDMParams(),
 ) -> DetectorKernel:
     """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
     if name == "ddm":
@@ -440,6 +613,24 @@ def make_detector(
             lambda s, e, v: eddm_batch(s, e, v, eddm),
             lambda s, e, v: eddm_window(s, e, v, eddm),
             eddm,
+        )
+    if name == "hddm":
+        if not 0.0 < hddm.drift_confidence < 1.0:
+            raise ValueError(
+                f"HDDMParams.drift_confidence must be in (0, 1), got "
+                f"{hddm.drift_confidence}"
+            )
+        if not 0.0 < hddm.warning_confidence < 1.0:
+            raise ValueError(
+                f"HDDMParams.warning_confidence must be in (0, 1), got "
+                f"{hddm.warning_confidence}"
+            )
+        return DetectorKernel(
+            "hddm",
+            hddm_init,
+            lambda s, e, v: hddm_batch(s, e, v, hddm),
+            lambda s, e, v: hddm_window(s, e, v, hddm),
+            hddm,
         )
     raise ValueError(
         f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"

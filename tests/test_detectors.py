@@ -18,6 +18,7 @@ from jax import lax
 
 from distributed_drift_detection_tpu.config import (
     EDDMParams,
+    HDDMParams,
     PHParams,
     RunConfig,
 )
@@ -27,6 +28,10 @@ from distributed_drift_detection_tpu.ops.detectors import (
     eddm_init,
     eddm_step,
     eddm_window,
+    hddm_batch,
+    hddm_init,
+    hddm_step,
+    hddm_window,
     ph_batch,
     ph_init,
     ph_step,
@@ -150,6 +155,51 @@ class OracleEDDMExact:
             self.in_warning = not self.in_change and ratio < self.p.warning_alpha
 
 
+class OracleHDDM:
+    """Independent per-element HDDM-A (Frías-Blanco et al. 2015 "A-test",
+    one-sided increase): stored cut = prefix minimising mean + ε(n); change
+    when whole-stream mean exceeds the cut's mean by the two-sample
+    Hoeffding bound."""
+
+    def __init__(self, p: HDDMParams):
+        self.p = p
+        self.n = 0
+        self.c = 0.0
+        self.n_min = 0
+        self.c_min = 0.0
+        self.in_warning = False
+        self.in_change = False
+
+    def add_element(self, x: float) -> None:
+        import math
+
+        self.n += 1
+        self.c += x
+        mean = self.c / self.n
+        eps = math.sqrt(math.log(1.0 / self.p.drift_confidence) / (2 * self.n))
+        if self.n_min == 0:
+            stored = math.inf
+        else:
+            stored = self.c_min / self.n_min + math.sqrt(
+                math.log(1.0 / self.p.drift_confidence) / (2 * self.n_min)
+            )
+        if mean + eps <= stored:  # later ties win, like DDM's minima
+            self.n_min, self.c_min = self.n, self.c
+
+        self.in_warning = self.in_change = False
+        if 0 < self.n_min < self.n:
+            m = (self.n - self.n_min) / (self.n_min * self.n)
+            diff = mean - self.c_min / self.n_min
+
+            def bound(conf):
+                return math.sqrt(m / 2 * math.log(2.0 / conf))
+
+            if diff >= bound(self.p.drift_confidence):
+                self.in_change = True
+            elif diff >= bound(self.p.warning_confidence):
+                self.in_warning = True
+
+
 def oracle_flags(oracle_cls, params, errs, valid):
     o = oracle_cls(params)
     warn = np.zeros(len(errs), bool)
@@ -180,6 +230,7 @@ def planted_stream(rng, n, flip_at, p0=0.05, p1=0.6):
 
 
 ED_EXACT = EDDMParams(min_num_errors=5, paper_exact=True)
+HD = HDDMParams()
 
 CASES = [
     ("ph", OraclePH, PH, ph_init, ph_step, ph_batch, ph_window),
@@ -188,6 +239,7 @@ CASES = [
     # oracle — proves the `contributes` masking on all three paths.
     ("eddm_exact", OracleEDDMExact, ED_EXACT,
      eddm_init, eddm_step, eddm_batch, eddm_window),
+    ("hddm", OracleHDDM, HD, hddm_init, hddm_step, hddm_batch, hddm_window),
 ]
 
 
@@ -208,11 +260,17 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
     assert int(res.first_change) == fc
     assert int(res.first_warning) == fw
     if fc < 0:  # end state only meaningful when no change fired
-        assert int(state.count) == o.count
-        if name == "ph":
+        if name == "hddm":
+            assert int(state.count) == o.n
+            assert int(state.n_min) == o.n_min
+            np.testing.assert_allclose(float(state.err_sum), o.c, rtol=1e-6)
+            np.testing.assert_allclose(float(state.c_min), o.c_min, rtol=1e-6)
+        elif name == "ph":
+            assert int(state.count) == o.count
             np.testing.assert_allclose(float(state.m), o.m, rtol=1e-4, atol=1e-5)
             np.testing.assert_allclose(float(state.x_sum), o.x_sum, rtol=1e-6)
         else:
+            assert int(state.count) == o.count
             assert int(state.num_errors) == o.num_errors
             assert int(state.last_err_t) == o.last_err_t
             np.testing.assert_allclose(float(state.d_sum), o.d_sum, rtol=1e-6)
@@ -262,12 +320,14 @@ def test_window_matches_chained_batches(
 
 
 def test_vmap_over_independent_lanes():
-    """Kernels hold up under vmap (the engine's partition axis)."""
+    """Kernels hold up under vmap (the engine's partition axis). P=2 keeps
+    the per-lane reference compiles cheap — the property is lane
+    independence, not lane count."""
     rng = np.random.default_rng(3)
-    P, B = 4, 128
+    P, B = 2, 128
     errs = (rng.random((P, B)) < 0.3).astype(np.float32)
     valid = np.ones((P, B), bool)
-    for name in ("ph", "eddm"):
+    for name in ("ph", "eddm", "hddm"):
         det = make_detector(name, ph=PH, eddm=ED)
         states = jax.vmap(lambda _: det.init())(jnp.arange(P))
         _, res = jax.vmap(det.batch)(states, jnp.asarray(errs), jnp.asarray(valid))
@@ -459,7 +519,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
-@pytest.mark.parametrize("detector", ["ph", "eddm"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
     """Non-DDM detectors fire near the planted concept boundaries end to end,
@@ -481,7 +541,7 @@ def _sequential_flags(detector):
 
 
 @pytest.mark.parametrize("rotations", [1, 3])
-@pytest.mark.parametrize("detector", ["ph", "eddm"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm"])
 def test_window_engine_matches_sequential(detector, rotations):
     """Window engine == sequential for the zoo members too, at both
     speculation depths (the level loop resets *any* DetectorKernel's state

@@ -392,7 +392,7 @@ def test_chained_soak_driver_on_mesh():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("det_name", ["ph", "eddm"])
+@pytest.mark.parametrize("det_name", ["ph", "eddm", "hddm"])
 def test_chained_soak_detector_zoo_matches_one_shot(det_name):
     """The chain's detector seam: zoo detectors flow through legs with the
     same carried-state exactness as DDM."""
@@ -405,28 +405,35 @@ def test_chained_soak_detector_zoo_matches_one_shot(det_name):
     _assert_chain_equals_one_shot(one.flags, chained, 4, 40 * 100)
 
 
-@pytest.mark.parametrize(
-    "det_name",
-    [
-        "ph",  # fast-tier representative: the auto-λ resolution is the point
-        pytest.param("eddm", marks=pytest.mark.slow),
-        pytest.param("ddm", marks=pytest.mark.slow),
-    ],
-)
-def test_soak_accepts_detector_names(det_name):
-    """``detector='ph'`` (a name string) works on every soak entry point:
-    the constructors resolve PH's threshold=0 auto sentinel from their own
-    ``drift_every`` (resolve_soak_detector) instead of tripping the kernels'
-    unresolved-λ rejection — the api.prepare auto-resolution pattern,
-    available to direct engine users too."""
+def test_soak_detector_name_resolution():
+    """``resolve_soak_detector`` builds kernels from name strings, with PH's
+    threshold=0 auto sentinel resolved from the soak's own ``drift_every``
+    (the api.prepare pattern, available to direct engine users) — pure
+    resolver checks, no device run (the runtime path is the slow test
+    below)."""
     from distributed_drift_detection_tpu.config import (
         DDMParams,
         auto_ph_threshold_rows,
     )
     from distributed_drift_detection_tpu.engine.soak import (
         resolve_soak_detector,
-        run_soak_chained,
     )
+
+    det = resolve_soak_detector(DDMParams(), "ph", 1000)
+    assert det.name == "ph"
+    assert det.params.threshold == auto_ph_threshold_rows(1000)
+    for name in ("ddm", "eddm", "hddm"):
+        assert resolve_soak_detector(DDMParams(), name, 1000).name == name
+    # non-strings pass through untouched (resolve_detector semantics)
+    assert resolve_soak_detector(DDMParams(), det, 1000) is det
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("det_name", ["ph", "eddm", "ddm", "hddm"])
+def test_soak_accepts_detector_names(det_name):
+    """``detector='ph'`` (a name string) works end to end on every soak
+    entry point instead of tripping the kernels' unresolved-λ rejection."""
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
 
     out = _run(num_batches=40, detector=det_name)
     cg = np.asarray(out.flags.change_global)
@@ -444,7 +451,3 @@ def test_soak_accepts_detector_names(det_name):
         detector=det_name,
     )
     assert s.detections == int((cg >= 0).sum())
-
-    # The resolved λ is the drift-geometry formula, not the rejected 0.
-    det = resolve_soak_detector(DDMParams(), "ph", 1000)
-    assert det.params.threshold == auto_ph_threshold_rows(1000)
