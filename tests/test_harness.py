@@ -302,7 +302,7 @@ def _append_worker(args):
 
     append_result(path, [f"app{i}", "t", "u", 1, 1.0, "-", 0,
                          0.5, 1.0, "d", 100, 1000, 2000.0, i,
-                         "centroid", "ddm"])
+                         "centroid", "ddm", i, 0, 1.0])
     return i
 
 
