@@ -8,7 +8,14 @@ jit-compiled streaming kernel vmapped over partitions and sharded over a
 ``jax.sharding.Mesh`` instead of a Spark cluster.
 """
 
-from .config import DDMParams, EDDMParams, PHParams, RunConfig, replace
+from .config import (
+    DDMParams,
+    EDDMParams,
+    HDDMParams,
+    PHParams,
+    RunConfig,
+    replace,
+)
 from .ops import (
     DDMState,
     DetectorKernel,
@@ -33,6 +40,7 @@ def run(cfg, stream=None):
 __all__ = [
     "DDMParams",
     "EDDMParams",
+    "HDDMParams",
     "PHParams",
     "RunConfig",
     "replace",

@@ -729,6 +729,18 @@ def run_soak_chained(
         template = jax.tree.map(_materialize_like, state_sh)
         state, meta = load_checkpoint(checkpoint_path, template)
         got = {k: meta.get(k) for k in geometry}
+        # Migration shim: EDDMParams grew a trailing `paper_exact` field in
+        # r04 (default False = 0.0, bit-identical flags to the pre-r04
+        # kernel), so an eddm checkpoint recording the old 3-float tuple is
+        # the SAME chain when the current run keeps the default — accept it
+        # rather than misdiagnosing a geometry mismatch and discarding
+        # completed legs.
+        if (
+            geometry["detector"] == "eddm"
+            and got.get("detector_params") == geometry["detector_params"][:3]
+            and geometry["detector_params"][3:] == [0.0]
+        ):
+            got["detector_params"] = geometry["detector_params"]
         if got != geometry:
             # A genuine geometry difference is the primary diagnosis; only
             # when geometry matches and solely the fingerprint is absent is

@@ -90,10 +90,11 @@ def _config_key(cfg: RunConfig) -> str:
     if cfg.window == 0 or cfg.window_rotations == 0:
         win += f"v{AUTO_POLICY_VERSION}"
     # The detector segment carries the active statistic's name + full
-    # parameter tuple. The default DDM keeps the historical key shape
-    # (``-ddm<min>_<warn>_<out>``) so existing results CSVs still resume;
-    # non-DDM detectors embed only their own params — the DDM tuple is
-    # inert for them and must not invalidate completed trials.
+    # parameter tuple; non-DDM detectors embed only their own params — the
+    # DDM tuple is inert for them and must not invalidate completed trials.
+    # (Pre-r04 rows are all retired anyway by the W×R segment above — the
+    # r04 default-policy change altered every trial's timing — so the
+    # detector segment's job is only to keep *future* keys stable.)
     if cfg.detector == "ddm":
         d = cfg.ddm
         det = f"ddm{d.min_num_instances}_{d.warning_level}_{d.out_control_level}"

@@ -128,6 +128,33 @@ def test_group_by_geometry_keeps_criteria_per_stream():
     assert pooled_gap not in per_stream_gaps
 
 
+def test_report_verdict_semantics():
+    """report() prints one correctly-named criterion line per non-baseline
+    model, gates the verdict on `required` only, and handles an
+    empty/absent required model without a vacuous pass (or a crash)."""
+    rows = (
+        _rows("rf", [50.0])
+        + _rows("centroid", [40.0])
+        + _rows("slowpoke", [61.0])
+    )
+    msgs = []
+    ok = report(rows, progress=msgs.append)
+    assert sum(m.startswith("centroid:") for m in msgs) == 1
+    assert sum(m.startswith("slowpoke:") for m in msgs) == 1
+    assert ok  # slowpoke FAILs both axes but is not required
+    assert any(m.startswith("slowpoke:") and "FAIL" in m for m in msgs)
+    assert not report(rows, progress=lambda *_: None, required=("slowpoke",))
+
+    # Baseline-only rows: a required model that was never measured is an
+    # unevaluated criterion, not a pass — and must not crash.
+    rf_only = _rows("rf", [50.0])
+    msgs2 = []
+    assert not report(rf_only, progress=msgs2.append)
+    assert any("required but not measured" in m for m in msgs2)
+    # Informational subset runs (nothing required) report and pass.
+    assert report(rf_only, progress=lambda *_: None, required=())
+
+
 def test_summarize_tolerates_legacy_rows_without_attribution():
     """Rows from a pre-attribution CSV still summarize (nan attribution)."""
     s = summarize([_legacy_row()])[0]

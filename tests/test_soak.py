@@ -337,6 +337,57 @@ def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
     assert resumed.rows_processed >= resumed.requested_rows
 
 
+def test_chained_soak_checkpoint_accepts_pre_paper_exact_eddm(tmp_path):
+    """Migration shim: an eddm checkpoint written before EDDMParams grew
+    ``paper_exact`` recorded a 3-float detector_params tuple; the default
+    (paper_exact=False) kernel is bit-identical to the pre-r04 one, so such
+    a checkpoint must resume rather than misdiagnose a geometry mismatch —
+    while an exact-mode resume still fails loudly."""
+    import json as _json
+
+    from distributed_drift_detection_tpu.config import EDDMParams
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+    from distributed_drift_detection_tpu.ops.detectors import make_detector
+
+    model = build_model("centroid", ModelSpec(8, 8))
+    ckpt = str(tmp_path / "chain_eddm.npz")
+
+    class Bomb(RuntimeError):
+        pass
+
+    def bomb(s, flags):
+        if s == 1:
+            raise Bomb()
+
+    kw = dict(partitions=4, per_batch=100, total_rows=40_000,
+              drift_every=1000, max_leg_rows=10_000, checkpoint_path=ckpt)
+    with pytest.raises(Bomb):
+        run_soak_chained(model, detector="eddm", on_leg=bomb, **kw)
+    assert os.path.exists(ckpt)
+
+    # Simulate the pre-r04 meta: strip the trailing paper_exact float.
+    data = dict(np.load(ckpt, allow_pickle=False))
+    meta = _json.loads(bytes(data["__meta__"]).decode())
+    assert len(meta["detector_params"]) == 4
+    meta["detector_params"] = meta["detector_params"][:3]
+    data["__meta__"] = np.frombuffer(_json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(ckpt, **data)
+
+    # exact mode is a real parameter change — still rejected…
+    with pytest.raises(ValueError, match="different[\\s\\S]*geometry"):
+        run_soak_chained(
+            model,
+            detector=make_detector(
+                "eddm", eddm=EDDMParams(paper_exact=True)
+            ),
+            **kw,
+        )
+    # …but the default-mode resume is the same chain: accepted.
+    resumed = run_soak_chained(model, detector="eddm", **kw)
+    assert resumed.legs >= 2
+    assert resumed.rows_processed >= resumed.requested_rows
+
+
 @pytest.mark.slow
 def test_chained_soak_mesh_sharded_matches_single_device():
     """The chain takes a mesh like every other engine: sharded legs produce
