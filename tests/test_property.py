@@ -143,6 +143,66 @@ def test_native_parse_block_matches_numpy(vals, cols, crlf, trailing_newline):
     np.testing.assert_array_equal(out, arr)
 
 
+_ZOO = {}
+
+
+def _zoo(name):
+    """One jitted batch kernel per zoo case (params static, compiled once
+    across hypothesis examples)."""
+    if not _ZOO:
+        from test_detectors import CASES
+
+        for cname, ocls, params, init, _step, batch, _window in CASES:
+            _ZOO[cname] = (
+                ocls,
+                params,
+                init,
+                jax.jit(lambda s, e, v, _b=batch, _p=params: _b(s, e, v, _p)),
+            )
+    return _ZOO[name]
+
+
+ZB = 48  # fixed zoo-batch length → one compile per case
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    data=st.data(),
+    name=st.sampled_from(["ph", "eddm", "eddm_exact", "hddm"]),
+)
+def test_zoo_batch_matches_oracle_on_fuzzed_streams(data, name):
+    """Detector-zoo batch kernels == their per-element oracles under fuzzed
+    error patterns AND fuzzed validity masks AND carried state across a
+    batch boundary (the engines' state-threading contract) — the
+    oracle-fuzzing net of test_ddm extended to every zoo member, including
+    the r04 hddm and paper-exact eddm paths."""
+    from test_detectors import firsts, oracle_flags
+
+    ocls, params, init, jbatch = _zoo(name)
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    # Clustered bursts (realistic post-drift shapes) atop i.i.d. noise.
+    p_base = data.draw(st.floats(0.02, 0.5))
+    errs = (rng.random(2 * ZB) < p_base).astype(np.float32)
+    if data.draw(st.booleans()):
+        at = data.draw(st.integers(0, 2 * ZB - 8))
+        errs[at : at + 8] = 1.0
+    valid = rng.random(2 * ZB) < data.draw(st.floats(0.5, 1.0))
+
+    o_warn, o_change, _ = oracle_flags(ocls, params, errs, valid)
+    e1, e2 = errs[:ZB], errs[ZB:]
+    v1, v2 = valid[:ZB], valid[ZB:]
+
+    s1, r1 = jbatch(init(), jnp.asarray(e1), jnp.asarray(v1))
+    fw1, fc1 = firsts(o_warn[:ZB], o_change[:ZB])
+    assert int(r1.first_change) == fc1
+    assert int(r1.first_warning) == fw1
+    if fc1 < 0:  # no reset: carried state must continue the oracle's stream
+        _, r2 = jbatch(s1, jnp.asarray(e2), jnp.asarray(v2))
+        fw2, fc2 = firsts(o_warn[ZB:], o_change[ZB:])
+        assert int(r2.first_change) == fc2
+        assert int(r2.first_warning) == fw2
+
+
 _ENGINES = {}
 
 
