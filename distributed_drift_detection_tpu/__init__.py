@@ -12,6 +12,7 @@ from .config import (
     DDMParams,
     EDDMParams,
     HDDMParams,
+    HDDMWParams,
     PHParams,
     RunConfig,
     replace,
@@ -41,6 +42,7 @@ __all__ = [
     "DDMParams",
     "EDDMParams",
     "HDDMParams",
+    "HDDMWParams",
     "PHParams",
     "RunConfig",
     "replace",

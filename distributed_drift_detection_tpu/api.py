@@ -100,6 +100,7 @@ def _cached_runner(
                 ph=cfg.ph,
                 eddm=cfg.eddm,
                 hddm=cfg.hddm,
+                hddm_w=cfg.hddm_w,
             ),
             rotations=cfg.window_rotations,
         )
@@ -111,7 +112,8 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.window_rotations,
+        cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w,
+        cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

@@ -114,10 +114,26 @@ class HDDMParams(NamedTuple):
     warning_confidence: float = 0.005
 
 
+class HDDMWParams(NamedTuple):
+    """HDDM-W hyper-parameters (detector='hddm_w', ops/detectors.py;
+    Frías-Blanco et al. 2015 "W-test" defaults).
+
+    The W-test is the EWMA companion of the A-test (:class:`HDDMParams`):
+    ``lam`` is the exponential forgetting weight of the moving averages
+    (larger = faster-forgetting, more reactive to abrupt drift, noisier);
+    the confidences gate the McDiarmid-style bounds on *weighted* means the
+    same way the A-test's gate its Hoeffding bounds — scale-free, so no
+    per-stream auto-resolution is needed here either."""
+
+    lam: float = 0.05
+    drift_confidence: float = 0.001
+    warning_confidence: float = 0.005
+
+
 # Valid RunConfig.detector values (kernels in ops/detectors.py). Lives here,
 # not in ops/, so jax-free consumers (the grid harness CLI) can validate
 # without initialising a backend.
-DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm")
+DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,15 +159,17 @@ class RunConfig:
 
     # --- detector (reference C6) ---
     # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' |
-    # 'hddm' (HDDM-A, Hoeffding-bound) — the detector zoo,
-    # ops/detectors.py. Non-DDM detectors are a framework extension: the
-    # reference only ships DDM, so cross-reference parity claims (delay
-    # tables, oracle goldens) hold for detector='ddm'.
+    # 'hddm' (HDDM-A, Hoeffding-bound) | 'hddm_w' (HDDM-W, its EWMA
+    # companion) — the detector zoo, ops/detectors.py. Non-DDM detectors are
+    # a framework extension: the reference only ships DDM, so
+    # cross-reference parity claims (delay tables, oracle goldens) hold for
+    # detector='ddm'.
     detector: str = "ddm"
     ddm: DDMParams = DDMParams()
     ph: PHParams = PHParams()
     eddm: EDDMParams = EDDMParams()
     hddm: HDDMParams = HDDMParams()
+    hddm_w: HDDMWParams = HDDMWParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

@@ -43,6 +43,21 @@ uniform :class:`DetectorKernel` seam the engines consume:
   paper-comparable runs; the default preserves the framework's historical
   flags.
 
+* **HDDM-W** (:func:`hddm_w_batch`) — the "W-test" companion of HDDM-A
+  (Frías-Blanco et al. 2015): the same cut-and-compare scheme on
+  *exponentially weighted* means. Maintain the stream EWMA ``z`` (weight
+  ``λ``: ``z ← λx + (1−λ)z``, initialised to the first element) and its
+  squared-relative-weight sum ``v`` (``v ← λ² + (1−λ)²v``, initialised to
+  1), which plays n⁻¹'s role in the McDiarmid-style deviation bound
+  ``ε(v, δ) = sqrt(v·ln(1/δ)/2)``. The stored cut is the prefix minimising
+  ``z + ε(v)`` (strict improvement — see below); elements after the cut
+  feed a second, freshly initialised EWMA ``(z₂, v₂)``, and change fires
+  when ``z₂ − z₁ ≥ sqrt((v₁+v₂)·ln(1/δ)/2)`` (one-sided increase, like the
+  A-test). Unlike the zoo's other minima (DDM, HDDM-A), the cut moves only
+  on **strict** key improvement: a tie-taking cut would also reset the
+  monitoring sample and discard accumulated post-cut evidence, so later
+  ties must *not* win here.
+
 * **HDDM-A** (:func:`hddm_batch`) — drift detection via Hoeffding's
   inequality, "A-test" (Frías-Blanco et al. 2015; the moving-average form
   popularised by skmultiflow's ``HDDM_A``): maintain the stream mean since
@@ -56,7 +71,7 @@ uniform :class:`DetectorKernel` seam the engines consume:
   implemented. Both knobs are scale-free confidences, so ``hddm`` needs no
   per-stream auto-resolution (contrast ``ph``'s λ).
 
-All three are implemented exactly like ``ops.ddm_batch``: the whole microbatch
+All four are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
 sums for the running statistics and an ``associative_scan`` for the
 sequential part. For Page–Hinkley the recurrence ``m → max(0, α·m + c)`` is
@@ -66,7 +81,11 @@ the between-error distances telescope through prefix sums over error
 events, and the running maximum is an ordinary ``cummax``. For HDDM-A the
 stored cut is a running minimum of ``mean + ε(n)`` with the ``(n, c)``
 prefix as payload — the same min-with-payload associative combine as DDM's
-``(p+s)`` minima (``ops.ddm._run_min``).
+``(p+s)`` minima (``ops.ddm._run_min``). For HDDM-W every recurrence is an
+*affine map* ``y → Ay + B`` (the two EWMAs, their weight sums, with reset /
+initialise expressed as ``A = 0``), and affine maps compose associatively —
+the cut positions are a running strict min of a key computable from prefix
+statistics alone, which then segments the second EWMA's resets.
 
 State-reset protocol matches the engines' DDM contract (``ops.ddm``): the
 *caller* resets on change (the reference discards its detector at
@@ -92,6 +111,7 @@ from ..config import (
     DETECTOR_NAMES,
     EDDMParams,
     HDDMParams,
+    HDDMWParams,
     PHParams,
 )
 from .ddm import (
@@ -568,6 +588,255 @@ def hddm_window(
 
 
 # --------------------------------------------------------------------------
+# HDDM-W
+# --------------------------------------------------------------------------
+
+
+class HDDMWState(NamedTuple):
+    """Carried HDDM-W state (scalar leaves; vmap adds axes).
+
+    ``(z, v)`` are the whole-stream EWMA and its squared-relative-weight sum
+    since reset; ``(z1, v1)`` the same pair frozen at the stored cut
+    (``v1 == 0`` = no cut yet — any real cut has ``v1 ≥ λ² > 0``); ``(n2,
+    z2, v2)`` the monitoring EWMA over the elements after the cut. The
+    stored cut *key* is not carried: it is recomputable as ``z1 + ε(v1)``
+    — the key was minimised at the very prefix whose ``(z, v)`` became the
+    payload."""
+
+    count: jax.Array  # i32: elements absorbed since last reset
+    z: jax.Array  # f32: stream EWMA
+    v: jax.Array  # f32: stream Σ(relative weight)², the bound condition
+    z1: jax.Array  # f32: EWMA frozen at the stored cut
+    v1: jax.Array  # f32: bound condition frozen at the cut (0 = no cut)
+    n2: jax.Array  # i32: elements absorbed after the cut
+    z2: jax.Array  # f32: post-cut EWMA
+    v2: jax.Array  # f32: post-cut bound condition
+
+
+def hddm_w_init() -> HDDMWState:
+    f = jnp.float32
+    return HDDMWState(
+        jnp.int32(0), f(0.0), f(0.0), f(0.0), f(0.0), jnp.int32(0), f(0.0),
+        f(0.0),
+    )
+
+
+def _validate_hddm_w(params: HDDMWParams) -> None:
+    """Reject out-of-range concrete params at every public kernel entry (the
+    ``_validate_ph`` pattern — a tracer is waved through; the registry has
+    already checked there). ``lam`` outside (0, 1) breaks both the EWMA
+    semantics and the affine compose's forgetting direction."""
+    try:
+        lam = float(params.lam)
+    except TypeError:  # jax ConcretizationTypeError is a TypeError
+        lam = None
+    if lam is not None and not 0.0 < lam < 1.0:
+        raise ValueError(f"HDDMWParams.lam must be in (0, 1), got {lam}")
+    for knob in ("drift_confidence", "warning_confidence"):
+        try:
+            conf = float(getattr(params, knob))
+        except TypeError:
+            conf = None
+        if conf is not None and not 0.0 < conf < 1.0:
+            raise ValueError(
+                f"HDDMWParams.{knob} must be in (0, 1), got {conf}"
+            )
+
+
+def _hddm_w_eps(v: jax.Array, confidence: float) -> jax.Array:
+    """Weighted deviation bound ε(v, δ) = sqrt(v · ln(1/δ) / 2) — the
+    McDiarmid/independent-bounded-difference analog of the A-test's
+    Hoeffding ε(n, δ); ``v = Σ(relative weight)²`` degenerates to ``1/n``
+    under uniform weights, recovering :func:`_hddm_eps` exactly."""
+    import math
+
+    return jnp.sqrt(v * jnp.float32(math.log(1.0 / confidence)) / 2.0)
+
+
+def hddm_w_step(
+    state: HDDMWState, err: jax.Array, params: HDDMWParams = HDDMWParams()
+) -> tuple[HDDMWState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec — see module docstring).
+
+    Update order matches the A-test's: the stream EWMA absorbs the element,
+    the candidate cut is considered *before* testing, and an element that
+    moves the cut resets the monitoring sample without joining it — so a
+    cut-moving element never signals (there is nothing after the cut yet).
+    """
+    _validate_hddm_w(params)
+    lam = jnp.float32(params.lam)
+    first = state.count == 0
+    n = state.count + 1
+    z = jnp.where(first, err, lam * err + (1.0 - lam) * state.z)
+    v = jnp.where(first, 1.0, lam * lam + (1.0 - lam) ** 2 * state.v)
+
+    key = z + _hddm_w_eps(v, params.drift_confidence)
+    stored = jnp.where(
+        state.v1 > 0,
+        state.z1 + _hddm_w_eps(state.v1, params.drift_confidence),
+        jnp.float32(_INF),
+    )
+    take = key < stored  # STRICT: ties keep the cut (and the sample2 evidence)
+    z1 = jnp.where(take, z, state.z1)
+    v1 = jnp.where(take, v, state.v1)
+
+    init2 = ~take & (state.n2 == 0)
+    n2 = jnp.where(take, 0, state.n2 + 1)
+    z2 = jnp.where(
+        take,
+        0.0,
+        jnp.where(init2, err, lam * err + (1.0 - lam) * state.z2),
+    )
+    v2 = jnp.where(
+        take,
+        0.0,
+        jnp.where(init2, 1.0, lam * lam + (1.0 - lam) ** 2 * state.v2),
+    )
+
+    testable = ~take  # n2 >= 1 by construction on this branch
+    diff = z2 - z1
+    change = testable & (
+        diff >= _hddm_w_eps(v1 + v2, params.drift_confidence)
+    )
+    warning = (
+        testable
+        & ~change
+        & (diff >= _hddm_w_eps(v1 + v2, params.warning_confidence))
+    )
+    return HDDMWState(n, z, v, z1, v1, n2, z2, v2), (warning, change)
+
+
+def _hddm_w_masks(
+    state: HDDMWState, errs: jax.Array, valid: jax.Array, params: HDDMWParams
+):
+    """Flat ``[N]`` prefix pass → ``(end_state, warning[N], change[N])``.
+
+    Every sequential recurrence here is an affine map ``y → Ay + B`` per
+    element — EWMA absorb is ``(1−λ, λx)``, initialise-to-first-element is
+    ``(0, x)``, reset is ``(0, 0)``, invalid is the identity ``(1, 0)`` —
+    and affine maps compose associatively, so one ``associative_scan`` per
+    (z, v) pair closes each chain. The cut needs no payload scan: its key
+    ``z + ε(v)`` depends only on prefix statistics, strict improvements are
+    exactly where the inclusive running min moves, and the frozen ``(z1,
+    v1)`` is a gather at the last improvement. Those improvement positions
+    then delimit the monitoring EWMA's reset segments."""
+    _validate_hddm_w(params)
+    lam = jnp.float32(params.lam)
+    one_m = 1.0 - lam
+    n_el = errs.shape[0]
+
+    v_i = valid.astype(jnp.int32)
+    n = state.count + jnp.cumsum(v_i)
+
+    def compose(f, g):  # apply `f`, then `g` — two independent affine maps
+        az1, bz1, av1, bv1 = f
+        az2, bz2, av2, bv2 = g
+        return (
+            az2 * az1,
+            az2 * bz1 + bz2,
+            av2 * av1,
+            av2 * bv1 + bv2,
+        )
+
+    # Stream EWMA (z, v): the first-ever valid element initialises.
+    is_init = valid & (n == 1)
+    absorb = valid & ~is_init
+    f0, f1 = jnp.float32(0.0), jnp.float32(1.0)
+    az = jnp.where(is_init, f0, jnp.where(absorb, one_m, f1))
+    bz = jnp.where(is_init, errs, jnp.where(absorb, lam * errs, f0))
+    av = jnp.where(is_init, f0, jnp.where(absorb, one_m * one_m, f1))
+    bv = jnp.where(is_init, f1, jnp.where(absorb, lam * lam, f0))
+    acz, bcz, acv, bcv = lax.associative_scan(compose, (az, bz, av, bv))
+    z = acz * state.z + bcz
+    v = acv * state.v + bcv
+
+    # Cut: strict running min of z + ε(v) (invalid elements can't cut).
+    key = jnp.where(
+        valid, z + _hddm_w_eps(v, params.drift_confidence), jnp.float32(_INF)
+    )
+    carried_key = jnp.where(
+        state.v1 > 0,
+        state.z1 + _hddm_w_eps(state.v1, params.drift_confidence),
+        jnp.float32(_INF),
+    )
+    incl_min = lax.cummin(key)
+    excl_min = jnp.concatenate(
+        [jnp.full((1,), _INF, key.dtype), incl_min[:-1]]
+    )
+    improve = valid & (key < jnp.minimum(excl_min, carried_key))
+
+    idx = jnp.where(improve, jnp.arange(n_el, dtype=jnp.int32), jnp.int32(-1))
+    last_imp = lax.cummax(idx)
+    has_cut = last_imp >= 0
+    gi = jnp.clip(last_imp, 0)
+    z1 = jnp.where(has_cut, z[gi], state.z1)
+    v1 = jnp.where(has_cut, v[gi], state.v1)
+
+    # Monitoring EWMA (z2, v2): segmented by the improvements. n2 counts the
+    # absorbed elements of the live segment (improvement positions absorb
+    # nothing — the cut-moving element never joins the sample it resets).
+    e2 = valid & ~improve
+    ce = jnp.cumsum(e2.astype(jnp.int32))
+    n2 = jnp.where(has_cut, ce - ce[gi], state.n2 + ce)
+    is_init2 = e2 & (n2 == 1)
+    absorb2 = e2 & ~is_init2
+    rz = improve | is_init2  # A = 0 positions of the z2/v2 chains
+    az2 = jnp.where(rz, f0, jnp.where(absorb2, one_m, f1))
+    bz2 = jnp.where(
+        improve, f0, jnp.where(is_init2, errs, jnp.where(absorb2, lam * errs, f0))
+    )
+    av2 = jnp.where(rz, f0, jnp.where(absorb2, one_m * one_m, f1))
+    bv2 = jnp.where(
+        improve, f0, jnp.where(is_init2, f1, jnp.where(absorb2, lam * lam, f0))
+    )
+    acz2, bcz2, acv2, bcv2 = lax.associative_scan(
+        compose, (az2, bz2, av2, bv2)
+    )
+    z2 = acz2 * state.z2 + bcz2
+    v2 = acv2 * state.v2 + bcv2
+
+    testable = e2 & (n2 >= 1)
+    diff = z2 - z1
+    change = testable & (
+        diff >= _hddm_w_eps(v1 + v2, params.drift_confidence)
+    )
+    warning = (
+        testable
+        & ~change
+        & (diff >= _hddm_w_eps(v1 + v2, params.warning_confidence))
+    )
+    end_state = HDDMWState(
+        n[-1], z[-1], v[-1], z1[-1], v1[-1], n2[-1], z2[-1], v2[-1]
+    )
+    return end_state, warning, change
+
+
+def hddm_w_batch(
+    state: HDDMWState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: HDDMWParams = HDDMWParams(),
+) -> tuple[HDDMWState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _hddm_w_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def hddm_w_window(
+    state: HDDMWState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: HDDMWParams = HDDMWParams(),
+) -> tuple[HDDMWState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _hddm_w_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -578,6 +847,7 @@ def make_detector(
     ph: PHParams = PHParams(),
     eddm: EDDMParams = EDDMParams(),
     hddm: HDDMParams = HDDMParams(),
+    hddm_w: HDDMWParams = HDDMWParams(),
 ) -> DetectorKernel:
     """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
     if name == "ddm":
@@ -631,6 +901,15 @@ def make_detector(
             lambda s, e, v: hddm_batch(s, e, v, hddm),
             lambda s, e, v: hddm_window(s, e, v, hddm),
             hddm,
+        )
+    if name == "hddm_w":
+        _validate_hddm_w(hddm_w)
+        return DetectorKernel(
+            "hddm_w",
+            hddm_w_init,
+            lambda s, e, v: hddm_w_batch(s, e, v, hddm_w),
+            lambda s, e, v: hddm_w_window(s, e, v, hddm_w),
+            hddm_w,
         )
     raise ValueError(
         f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"

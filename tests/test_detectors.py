@@ -19,6 +19,7 @@ from jax import lax
 from distributed_drift_detection_tpu.config import (
     EDDMParams,
     HDDMParams,
+    HDDMWParams,
     PHParams,
     RunConfig,
 )
@@ -31,6 +32,10 @@ from distributed_drift_detection_tpu.ops.detectors import (
     hddm_batch,
     hddm_init,
     hddm_step,
+    hddm_w_batch,
+    hddm_w_init,
+    hddm_w_step,
+    hddm_w_window,
     hddm_window,
     ph_batch,
     ph_init,
@@ -200,6 +205,60 @@ class OracleHDDM:
                 self.in_warning = True
 
 
+class OracleHDDMW:
+    """Independent per-element HDDM-W (Frías-Blanco et al. 2015 "W-test",
+    one-sided increase): EWMA cut-and-compare with weighted deviation
+    bounds ε(v, δ) = sqrt(v·ln(1/δ)/2); the cut moves on *strict* key
+    improvement only (a tie-taking cut would discard sample-2 evidence)."""
+
+    def __init__(self, p: HDDMWParams):
+        self.p = p
+        self.n = 0
+        self.z = 0.0  # stream EWMA
+        self.v = 0.0  # stream sum of squared relative weights
+        self.z1 = 0.0  # frozen at the cut
+        self.v1 = 0.0  # 0 = no cut yet
+        self.n2 = 0
+        self.z2 = 0.0  # post-cut EWMA
+        self.v2 = 0.0
+        self.in_warning = False
+        self.in_change = False
+
+    def add_element(self, x: float) -> None:
+        import math
+
+        lam = self.p.lam
+
+        def eps(v, conf):
+            return math.sqrt(v * math.log(1.0 / conf) / 2.0)
+
+        first = self.n == 0
+        self.n += 1
+        self.z = x if first else lam * x + (1 - lam) * self.z
+        self.v = 1.0 if first else lam * lam + (1 - lam) ** 2 * self.v
+
+        key = self.z + eps(self.v, self.p.drift_confidence)
+        stored = (
+            self.z1 + eps(self.v1, self.p.drift_confidence)
+            if self.v1 > 0
+            else math.inf
+        )
+        self.in_warning = self.in_change = False
+        if key < stored:  # strict: ties keep the cut and the evidence
+            self.z1, self.v1 = self.z, self.v
+            self.n2, self.z2, self.v2 = 0, 0.0, 0.0
+            return
+        init2 = self.n2 == 0
+        self.n2 += 1
+        self.z2 = x if init2 else lam * x + (1 - lam) * self.z2
+        self.v2 = 1.0 if init2 else lam * lam + (1 - lam) ** 2 * self.v2
+        diff = self.z2 - self.z1
+        if diff >= eps(self.v1 + self.v2, self.p.drift_confidence):
+            self.in_change = True
+        elif diff >= eps(self.v1 + self.v2, self.p.warning_confidence):
+            self.in_warning = True
+
+
 def oracle_flags(oracle_cls, params, errs, valid):
     o = oracle_cls(params)
     warn = np.zeros(len(errs), bool)
@@ -231,6 +290,7 @@ def planted_stream(rng, n, flip_at, p0=0.05, p1=0.6):
 
 ED_EXACT = EDDMParams(min_num_errors=5, paper_exact=True)
 HD = HDDMParams()
+HW = HDDMWParams()
 
 CASES = [
     ("ph", OraclePH, PH, ph_init, ph_step, ph_batch, ph_window),
@@ -240,6 +300,8 @@ CASES = [
     ("eddm_exact", OracleEDDMExact, ED_EXACT,
      eddm_init, eddm_step, eddm_batch, eddm_window),
     ("hddm", OracleHDDM, HD, hddm_init, hddm_step, hddm_batch, hddm_window),
+    ("hddm_w", OracleHDDMW, HW,
+     hddm_w_init, hddm_w_step, hddm_w_batch, hddm_w_window),
 ]
 
 
@@ -260,7 +322,17 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
     assert int(res.first_change) == fc
     assert int(res.first_warning) == fw
     if fc < 0:  # end state only meaningful when no change fired
-        if name == "hddm":
+        if name == "hddm_w":
+            assert int(state.count) == o.n
+            assert int(state.n2) == o.n2
+            for got, want in (
+                (state.z, o.z), (state.v, o.v), (state.z1, o.z1),
+                (state.v1, o.v1), (state.z2, o.z2), (state.v2, o.v2),
+            ):
+                np.testing.assert_allclose(
+                    float(got), want, rtol=1e-4, atol=1e-6
+                )
+        elif name == "hddm":
             assert int(state.count) == o.n
             assert int(state.n_min) == o.n_min
             np.testing.assert_allclose(float(state.err_sum), o.c, rtol=1e-6)
@@ -327,7 +399,7 @@ def test_vmap_over_independent_lanes():
     P, B = 2, 128
     errs = (rng.random((P, B)) < 0.3).astype(np.float32)
     valid = np.ones((P, B), bool)
-    for name in ("ph", "eddm", "hddm"):
+    for name in ("ph", "eddm", "hddm", "hddm_w"):
         det = make_detector(name, ph=PH, eddm=ED)
         states = jax.vmap(lambda _: det.init())(jnp.arange(P))
         _, res = jax.vmap(det.batch)(states, jnp.asarray(errs), jnp.asarray(valid))
@@ -379,6 +451,22 @@ def test_ph_rejects_alpha_out_of_range():
         ph_batch(ph_init(), e, v, PHParams(alpha=-0.5))
     with pytest.raises(ValueError, match="alpha"):
         ph_window(ph_init(), e.reshape(2, 4), v.reshape(2, 4), PHParams(alpha=1.5))
+
+
+def test_hddm_w_rejects_bad_params():
+    with pytest.raises(ValueError, match="lam"):
+        make_detector("hddm_w", hddm_w=HDDMWParams(lam=0.0))
+    with pytest.raises(ValueError, match="lam"):
+        make_detector("hddm_w", hddm_w=HDDMWParams(lam=1.0))
+    with pytest.raises(ValueError, match="drift_confidence"):
+        make_detector("hddm_w", hddm_w=HDDMWParams(drift_confidence=1.5))
+    # the public kernels enforce the same preconditions directly
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="lam"):
+        hddm_w_batch(hddm_w_init(), e, v, HDDMWParams(lam=-0.1))
+    with pytest.raises(ValueError, match="lam"):
+        hddm_w_step(hddm_w_init(), jnp.float32(1.0), HDDMWParams(lam=2.0))
 
 
 def test_ph_threshold_zero_means_auto():
@@ -519,7 +607,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
     """Non-DDM detectors fire near the planted concept boundaries end to end,
@@ -541,7 +629,7 @@ def _sequential_flags(detector):
 
 
 @pytest.mark.parametrize("rotations", [1, 3])
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w"])
 def test_window_engine_matches_sequential(detector, rotations):
     """Window engine == sequential for the zoo members too, at both
     speculation depths (the level loop resets *any* DetectorKernel's state

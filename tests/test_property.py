@@ -168,14 +168,14 @@ ZB = 48  # fixed zoo-batch length → one compile per case
 @settings(max_examples=15, deadline=None)
 @given(
     data=st.data(),
-    name=st.sampled_from(["ph", "eddm", "eddm_exact", "hddm"]),
+    name=st.sampled_from(["ph", "eddm", "eddm_exact", "hddm", "hddm_w"]),
 )
 def test_zoo_batch_matches_oracle_on_fuzzed_streams(data, name):
     """Detector-zoo batch kernels == their per-element oracles under fuzzed
     error patterns AND fuzzed validity masks AND carried state across a
     batch boundary (the engines' state-threading contract) — the
     oracle-fuzzing net of test_ddm extended to every zoo member, including
-    the r04 hddm and paper-exact eddm paths."""
+    the r04 hddm/hddm_w and paper-exact eddm paths."""
     from test_detectors import firsts, oracle_flags
 
     ocls, params, init, jbatch = _zoo(name)
