@@ -11,6 +11,7 @@ jit-compiled streaming kernel vmapped over partitions and sharded over a
 from .config import (
     DDMParams,
     EDDMParams,
+    ADWINParams,
     HDDMParams,
     HDDMWParams,
     PHParams,
@@ -41,6 +42,7 @@ def run(cfg, stream=None):
 __all__ = [
     "DDMParams",
     "EDDMParams",
+    "ADWINParams",
     "HDDMParams",
     "HDDMWParams",
     "PHParams",

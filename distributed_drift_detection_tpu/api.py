@@ -101,6 +101,7 @@ def _cached_runner(
                 eddm=cfg.eddm,
                 hddm=cfg.hddm,
                 hddm_w=cfg.hddm_w,
+                adwin=cfg.adwin,
             ),
             rotations=cfg.window_rotations,
         )
@@ -112,7 +113,7 @@ def _cached_runner(
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
-        cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w,
+        cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w, cfg.adwin,
         cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:

@@ -130,10 +130,38 @@ class HDDMWParams(NamedTuple):
     warning_confidence: float = 0.005
 
 
-# Valid RunConfig.detector values (kernels in ops/detectors.py). Lives here,
-# not in ops/, so jax-free consumers (the grid harness CLI) can validate
-# without initialising a backend.
-DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w")
+class ADWINParams(NamedTuple):
+    """ADWIN hyper-parameters (detector='adwin', ops/adwin.py; Bifet &
+    Gavaldà 2007 "ADaptive WINdowing").
+
+    ``delta`` is the detection confidence of the adaptive-window cut test
+    (smaller = fewer false alarms, longer delay). ``clock`` amortises the
+    cut scan: splits are tested every ``clock``-th absorbed element (the
+    classic implementation's default of 32), so detection positions are
+    quantised to clock boundaries. ``max_buckets`` (the paper's M) bounds
+    the per-level bucket count of the exponential histogram and
+    ``max_levels`` its depth — capacity is ``M·(2^max_levels − 1)``
+    elements (~84 M at the defaults), beyond which the oldest bucket is
+    forgotten (bounded-memory sliding window); the capacity must fit int32
+    (validated), and the absorb counter shares that 2³¹ ceiling per
+    reset-free stream — the engines reset on every change, and the >2³¹
+    soak machinery runs chained legs, so neither limit binds in practice.
+    ``min_window`` / ``min_side`` gate the test on minimum evidence (whole
+    window / either side of a split). All knobs are scale-free — no
+    per-stream auto-resolution is needed."""
+
+    delta: float = 0.002
+    clock: int = 32
+    max_buckets: int = 5
+    max_levels: int = 24
+    min_window: int = 10
+    min_side: int = 5
+
+
+# Valid RunConfig.detector values (kernels in ops/detectors.py +
+# ops/adwin.py). Lives here, not in ops/, so jax-free consumers (the grid
+# harness CLI) can validate without initialising a backend.
+DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,16 +188,18 @@ class RunConfig:
     # --- detector (reference C6) ---
     # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' |
     # 'hddm' (HDDM-A, Hoeffding-bound) | 'hddm_w' (HDDM-W, its EWMA
-    # companion) — the detector zoo, ops/detectors.py. Non-DDM detectors are
-    # a framework extension: the reference only ships DDM, so
-    # cross-reference parity claims (delay tables, oracle goldens) hold for
-    # detector='ddm'.
+    # companion) | 'adwin' (adaptive windowing; the zoo's only
+    # scan-of-steps kernel — see ops/adwin.py) — the detector zoo,
+    # ops/detectors.py. Non-DDM detectors are a framework extension: the
+    # reference only ships DDM, so cross-reference parity claims (delay
+    # tables, oracle goldens) hold for detector='ddm'.
     detector: str = "ddm"
     ddm: DDMParams = DDMParams()
     ph: PHParams = PHParams()
     eddm: EDDMParams = EDDMParams()
     hddm: HDDMParams = HDDMParams()
     hddm_w: HDDMWParams = HDDMWParams()
+    adwin: ADWINParams = ADWINParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

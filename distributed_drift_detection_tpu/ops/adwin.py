@@ -1,0 +1,258 @@
+"""ADWIN: adaptive-windowing drift detection (Bifet & Gavaldà 2007).
+
+The zoo's other detectors (``ops.ddm``, ``ops.detectors``) are O(1)-state
+recurrences whose batch passes close into prefix sums and associative
+scans. ADWIN is structurally different: it maintains a *variable-length*
+window of recent error indicators in an exponential histogram — up to ``M``
+buckets per dyadic size 2^k, merged oldest-first on overflow — and signals
+change when any split of that window into old/new halves shows a mean gap
+exceeding the cut bound
+
+    ε_cut = sqrt((2/m)·σ²_W·ln(2/δ′)) + (2/(3m))·ln(2/δ′),
+    1/m = 1/n₀ + 1/n₁,   δ′ = δ/n
+
+(paper Thm 3.2 form, with the classic implementation's per-split δ′ = δ/n).
+Which buckets merge when is data-*independent* (a pure function of the
+insert count), but the histogram update is inherently sequential per
+element, so this kernel is the zoo's one scan-of-steps member: a
+``lax.scan`` over elements whose step does O(L·M) fixed-shape vector work
+(bucket cascade + masked cut scan). Amortisation comes from ``clock`` —
+the cut scan only *counts* (is only unmasked) every clock-th element, the
+classic default 32 — and from the engines' vmap over partitions, which
+shares one scan across every lane. Budget ~1–3 µs/element of scan overhead
+per sequential step; prefer the prefix-scan detectors where their
+assumptions fit and ADWIN where its distribution-free adaptive window is
+worth the sequential cost.
+
+Two deliberate simplifications, both documented invariants of this
+framework rather than of the paper:
+
+* **Bernoulli inputs.** The engines feed 0/1 error indicators
+  (``DDM_Process.py:117,126`` semantics), so the window variance needed by
+  ε_cut is ``p(1−p)`` with ``p = window mean`` — bucket variances
+  (the paper's within-bucket Welford terms) need not be tracked at all.
+  Feeding non-indicator reals would silently mis-scale ε_cut; the scalar
+  spec documents the contract.
+* **Reset-on-change, not shrink-on-change.** ADWIN classically *shrinks*
+  the window (dropping oldest buckets) when a cut fires and carries on;
+  this framework's engines own the reset — on change the caller discards
+  detector state and retrains (the reference's protocol at
+  ``DDM_Process.py:207-210``, shared by every zoo member). The kernel
+  therefore only ever *reports* the first violated cut; elements after a
+  batch's first change are dead and the returned end-state is meaningful
+  only when ``first_change == -1`` (``ops.ddm`` contract). The histogram
+  still forgets at capacity (oldest bucket dropped, totals adjusted) so
+  state stays bounded on drift-free streams.
+
+No warning zone: the statistic has no natural warning analog (unlike DDM's
+two-level minima test), and the classic implementations report none —
+``first_warning`` is always −1 for this detector.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import ADWINParams
+from .ddm import DDMBatchResult, DDMWindowResult, summarise_batch, summarise_window
+
+
+class ADWINState(NamedTuple):
+    """Carried ADWIN state (fixed shapes; vmap adds axes).
+
+    ``sums[L, C]`` holds bucket sums oldest-first per level (level k buckets
+    span 2^k elements; ``C = max_buckets + 1`` slots so one overflow fits
+    before the cascade trims); ``counts[L]`` the live buckets per level.
+    ``n``/``total`` are the window length and sum (they lag ``t``, the
+    absorb counter driving the clock, once capacity forgetting starts)."""
+
+    t: jax.Array  # i32: elements absorbed since reset (clock phase)
+    n: jax.Array  # i32: elements currently represented in the window
+    total: jax.Array  # f32: window sum
+    sums: jax.Array  # f32 [L, C]: bucket sums, oldest-first per level
+    counts: jax.Array  # i32 [L]: live buckets per level
+
+
+def adwin_init(params: ADWINParams = ADWINParams()) -> ADWINState:
+    L, C = params.max_levels, params.max_buckets + 1
+    return ADWINState(
+        t=jnp.int32(0),
+        n=jnp.int32(0),
+        total=jnp.float32(0.0),
+        sums=jnp.zeros((L, C), jnp.float32),
+        counts=jnp.zeros((L,), jnp.int32),
+    )
+
+
+def _validate_adwin(params: ADWINParams) -> None:
+    """Reject out-of-range concrete params at every public kernel entry
+    (the ``_validate_ph`` pattern). These are Python ints/floats in
+    practice — they size arrays and gate masks — so unlike the other
+    zoo members there is no traced-params path to wave through."""
+    if not 0.0 < float(params.delta) < 1.0:
+        raise ValueError(f"ADWINParams.delta must be in (0, 1), got {params.delta}")
+    if int(params.clock) < 1:
+        raise ValueError(f"ADWINParams.clock must be >= 1, got {params.clock}")
+    if int(params.max_buckets) < 2:
+        raise ValueError(
+            f"ADWINParams.max_buckets must be >= 2, got {params.max_buckets}"
+        )
+    if not 1 <= int(params.max_levels) <= 30:
+        raise ValueError(
+            "ADWINParams.max_levels must be in [1, 30] (2^k bucket sizes in "
+            f"int32), got {params.max_levels}"
+        )
+    capacity = int(params.max_buckets) * ((1 << int(params.max_levels)) - 1)
+    if capacity > 2**31 - 1:
+        raise ValueError(
+            "ADWINParams window capacity max_buckets*(2^max_levels - 1) = "
+            f"{capacity} overflows the int32 n counter; shrink max_levels "
+            "or max_buckets (the defaults' ~84M is far past any practical "
+            "between-reset span)"
+        )
+    if int(params.min_side) < 1 or int(params.min_window) < 2 * int(params.min_side):
+        raise ValueError(
+            "ADWINParams needs min_side >= 1 and min_window >= 2*min_side, "
+            f"got min_window={params.min_window}, min_side={params.min_side}"
+        )
+
+
+def adwin_step(
+    state: ADWINState, err: jax.Array, params: ADWINParams = ADWINParams()
+) -> tuple[ADWINState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec): insert → cascade → (clocked) cut scan.
+
+    ``err`` must be a 0/1 error indicator (module docstring: the window
+    variance is derived as ``p(1−p)``). Returns ``(state, (warning,
+    change))`` with ``warning`` constantly False.
+    """
+    _validate_adwin(params)
+    L, M = int(params.max_levels), int(params.max_buckets)
+    C = M + 1
+
+    # --- insert: a fresh single-element bucket at level 0 --------------
+    c0 = state.counts[0]  # ≤ M post-cascade, so slot c0 ≤ C-1 exists
+    sums = state.sums.at[0, c0].set(err.astype(jnp.float32))
+    counts = state.counts.at[0].add(1)
+    t = state.t + 1
+    n = state.n + 1
+    total = state.total + err.astype(jnp.float32)
+
+    # --- cascade: one top-down pass suffices (each level gains ≤ 1) ----
+    def level(k, carry):
+        sums, counts, n, total = carry
+        over = counts[k] > M
+        top = k == L - 1
+        row = sums[k]
+        merged = row[0] + row[1]
+        # Candidate rows: drop the oldest two (merge) or the oldest one
+        # (top-level capacity forgetting). C is tiny, rolls are free.
+        drop2 = jnp.roll(row, -2).at[-2:].set(0.0)
+        drop1 = jnp.roll(row, -1).at[-1].set(0.0)
+        new_row = jnp.where(over, jnp.where(top, drop1, drop2), row)
+        sums = sums.at[k].set(new_row)
+        counts = counts.at[k].add(jnp.where(over, jnp.where(top, -1, -2), 0))
+        # Push the merged bucket one level up (guarded index write: when at
+        # the top, tgt folds back to k and the delta/value are no-ops).
+        push = over & ~top
+        tgt = jnp.minimum(k + 1, L - 1)
+        slot = counts[tgt]  # ≤ M pre-push (invariant), so the slot exists
+        cur = sums[tgt, slot]
+        sums = sums.at[tgt, slot].set(jnp.where(push, merged, cur))
+        counts = counts.at[tgt].add(jnp.where(push, 1, 0))
+        # Top-level forgetting: the dropped oldest bucket leaves the window.
+        n = n - jnp.where(over & top, jnp.int32(1 << (L - 1)), 0)
+        total = total - jnp.where(over & top, row[0], 0.0)
+        return sums, counts, n, total
+
+    sums, counts, n, total = lax.fori_loop(
+        0, L, level, (sums, counts, n, total)
+    )
+
+    # --- clocked cut scan over every bucket boundary -------------------
+    do_check = (t % params.clock == 0) & (n >= params.min_window)
+    # Flatten oldest→newest: highest level first, slot 0 first within one.
+    lvl_sizes = (jnp.int32(1) << jnp.arange(L, dtype=jnp.int32))[::-1]
+    valid_slot = jnp.arange(C, dtype=jnp.int32)[None, :] < counts[::-1, None]
+    szs = jnp.where(valid_slot, lvl_sizes[:, None], 0).reshape(-1)
+    sms = jnp.where(valid_slot, sums[::-1], 0.0).reshape(-1)
+    n0 = jnp.cumsum(szs)
+    s0 = jnp.cumsum(sms)
+    n1 = n - n0
+    s1 = total - s0
+    n0f = jnp.maximum(n0, 1).astype(jnp.float32)
+    n1f = jnp.maximum(n1, 1).astype(jnp.float32)
+    mu0 = s0 / n0f
+    mu1 = s1 / n1f
+    p = total / jnp.maximum(n, 1).astype(jnp.float32)
+    var_w = p * (1.0 - p)  # Bernoulli inputs: σ²_W = p(1−p)
+    # ln(2/δ′) with δ′ = δ/n
+    lg = jnp.float32(math.log(2.0 / float(params.delta))) + jnp.log(
+        jnp.maximum(n, 1).astype(jnp.float32)
+    )
+    inv_m = 1.0 / n0f + 1.0 / n1f
+    eps_cut = jnp.sqrt(2.0 * inv_m * var_w * lg) + (2.0 / 3.0) * inv_m * lg
+    testable = (
+        valid_slot.reshape(-1)
+        & (n0 >= params.min_side)
+        & (n1 >= params.min_side)
+    )
+    viol = testable & (jnp.abs(mu0 - mu1) >= eps_cut)
+    change = do_check & viol.any()
+
+    new_state = ADWINState(t, n, total, sums, counts)
+    return new_state, (jnp.bool_(False), change)
+
+
+def _adwin_masks(
+    state: ADWINState, errs: jax.Array, valid: jax.Array, params: ADWINParams
+):
+    """Flat ``[N]`` scan-of-steps → ``(end_state, warning[N], change[N])``.
+
+    Invalid (padded) elements are the identity: the step runs, its state is
+    discarded leaf-wise. XLA computes both sides of the select, but the
+    step is O(L·M) scalar-vector work — the scan's sequential latency, not
+    its per-step FLOPs, is the cost (module docstring)."""
+    _validate_adwin(params)
+
+    def body(carry, ev):
+        e, v = ev
+        stepped, (_w, ch) = adwin_step(carry, e, params)
+        keep = jax.tree.map(
+            lambda new, old: jnp.where(v, new, old), stepped, carry
+        )
+        return keep, ch & v
+
+    end_state, change = lax.scan(body, state, (errs, valid))
+    warning = jnp.zeros_like(change)
+    return end_state, warning, change
+
+
+def adwin_batch(
+    state: ADWINState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: ADWINParams = ADWINParams(),
+) -> tuple[ADWINState, DDMBatchResult]:
+    """Microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _adwin_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def adwin_window(
+    state: ADWINState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: ADWINParams = ADWINParams(),
+) -> tuple[ADWINState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _adwin_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)

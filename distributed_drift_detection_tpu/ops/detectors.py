@@ -3,8 +3,10 @@
 The reference is a single-detector artifact — its only statistic is
 skmultiflow's ``DDM`` (``DDM_Process.py:133,139``; rebuilt TPU-native in
 ``ops.ddm``). A drift-detection *framework* owes its users the standard
-alternatives, so this module adds three classic error-stream detectors and a
-uniform :class:`DetectorKernel` seam the engines consume:
+alternatives, so this module adds four classic error-stream detectors (a
+fifth, adaptive windowing, lives in ``ops.adwin`` — structurally a
+different beast) and a uniform :class:`DetectorKernel` seam the engines
+consume:
 
 * **Page–Hinkley** (:func:`ph_batch`) — the clamped CUSUM test (Page 1954;
   the streaming form popularised by Gama et al.'s drift surveys): per error
@@ -107,6 +109,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import (
+    ADWINParams,
     DDMParams,
     DETECTOR_NAMES,
     EDDMParams,
@@ -848,6 +851,7 @@ def make_detector(
     eddm: EDDMParams = EDDMParams(),
     hddm: HDDMParams = HDDMParams(),
     hddm_w: HDDMWParams = HDDMWParams(),
+    adwin: ADWINParams = ADWINParams(),
 ) -> DetectorKernel:
     """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
     if name == "ddm":
@@ -910,6 +914,17 @@ def make_detector(
             lambda s, e, v: hddm_w_batch(s, e, v, hddm_w),
             lambda s, e, v: hddm_w_window(s, e, v, hddm_w),
             hddm_w,
+        )
+    if name == "adwin":
+        from .adwin import _validate_adwin, adwin_batch, adwin_init, adwin_window
+
+        _validate_adwin(adwin)
+        return DetectorKernel(
+            "adwin",
+            lambda: adwin_init(adwin),
+            lambda s, e, v: adwin_batch(s, e, v, adwin),
+            lambda s, e, v: adwin_window(s, e, v, adwin),
+            adwin,
         )
     raise ValueError(
         f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"

@@ -36,7 +36,7 @@ def main():
 
     print(f"{'detector':<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
           f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
-    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w"):
+    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin"):
         res = run(replace(base, detector=name))
         m = res.metrics
         a = attribution_metrics(

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_drift_detection_tpu.config import (
+    ADWINParams,
     EDDMParams,
     HDDMParams,
     HDDMWParams,
@@ -24,6 +25,12 @@ from distributed_drift_detection_tpu.config import (
     RunConfig,
 )
 from distributed_drift_detection_tpu.ops import make_detector
+from distributed_drift_detection_tpu.ops.adwin import (
+    adwin_batch,
+    adwin_init,
+    adwin_step,
+    adwin_window,
+)
 from distributed_drift_detection_tpu.ops.detectors import (
     eddm_batch,
     eddm_init,
@@ -259,6 +266,65 @@ class OracleHDDMW:
             self.in_warning = True
 
 
+class OracleADWIN:
+    """Independent per-element ADWIN (Bifet & Gavaldà 2007) mirroring the
+    kernel's documented spec (ops/adwin.py): exponential histogram with M
+    buckets/level merged oldest-first, capacity forgetting at the top
+    level, clocked cut scan with ε_cut = sqrt(2/m·σ²·ln(2/δ′)) +
+    2/(3m)·ln(2/δ′), δ′ = δ/n, σ² = p(1−p) (Bernoulli inputs)."""
+
+    def __init__(self, p: ADWINParams):
+        self.p = p
+        self.t = 0
+        self.n = 0
+        self.total = 0.0
+        self.levels = [[] for _ in range(p.max_levels)]  # sums, oldest first
+        self.in_warning = False
+        self.in_change = False
+
+    def add_element(self, x: float) -> None:
+        import math
+
+        p = self.p
+        L, M = p.max_levels, p.max_buckets
+        self.t += 1
+        self.n += 1
+        self.total += x
+        self.levels[0].append(x)
+        for k in range(L):
+            if len(self.levels[k]) > M:
+                if k == L - 1:  # capacity: forget the oldest bucket
+                    old = self.levels[k].pop(0)
+                    self.n -= 1 << k
+                    self.total -= old
+                else:
+                    a = self.levels[k].pop(0)
+                    b = self.levels[k].pop(0)
+                    self.levels[k + 1].append(a + b)
+        self.in_change = self.in_warning = False
+        if self.t % p.clock or self.n < p.min_window:
+            return
+        mean = self.total / self.n
+        var = mean * (1.0 - mean)
+        lg = math.log(2.0 / p.delta) + math.log(self.n)
+        n0, s0 = 0, 0.0
+        for k in reversed(range(L)):
+            for sm in self.levels[k]:
+                n0 += 1 << k
+                s0 += sm
+                n1 = self.n - n0
+                if n0 < p.min_side or n1 < p.min_side:
+                    continue
+                s1 = self.total - s0
+                inv_m = 1.0 / n0 + 1.0 / n1
+                eps = math.sqrt(2.0 * inv_m * var * lg) + (
+                    2.0 / 3.0
+                ) * inv_m * lg
+                if abs(s0 / n0 - s1 / n1) >= eps:
+                    self.in_change = True
+                    return
+
+
 def oracle_flags(oracle_cls, params, errs, valid):
     o = oracle_cls(params)
     warn = np.zeros(len(errs), bool)
@@ -291,6 +357,10 @@ def planted_stream(rng, n, flip_at, p0=0.05, p1=0.6):
 ED_EXACT = EDDMParams(min_num_errors=5, paper_exact=True)
 HD = HDDMParams()
 HW = HDDMWParams()
+# Small levels keep the scan-of-steps spec path cheap; capacity (5*(2^12-1)
+# = 20k elements) still exceeds every CASES stream, so forgetting is
+# exercised by its own test below, not silently here.
+AD = ADWINParams(max_levels=12)
 
 CASES = [
     ("ph", OraclePH, PH, ph_init, ph_step, ph_batch, ph_window),
@@ -302,6 +372,8 @@ CASES = [
     ("hddm", OracleHDDM, HD, hddm_init, hddm_step, hddm_batch, hddm_window),
     ("hddm_w", OracleHDDMW, HW,
      hddm_w_init, hddm_w_step, hddm_w_batch, hddm_w_window),
+    ("adwin", OracleADWIN, AD,
+     lambda: adwin_init(AD), adwin_step, adwin_batch, adwin_window),
 ]
 
 
@@ -322,7 +394,17 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
     assert int(res.first_change) == fc
     assert int(res.first_warning) == fw
     if fc < 0:  # end state only meaningful when no change fired
-        if name == "hddm_w":
+        if name == "adwin":
+            assert int(state.t) == o.t
+            assert int(state.n) == o.n
+            np.testing.assert_allclose(float(state.total), o.total, rtol=1e-6)
+            counts = [len(lv) for lv in o.levels]
+            np.testing.assert_array_equal(np.asarray(state.counts), counts)
+            for k, lv in enumerate(o.levels):
+                np.testing.assert_allclose(
+                    np.asarray(state.sums)[k, : len(lv)], lv, rtol=1e-6
+                )
+        elif name == "hddm_w":
             assert int(state.count) == o.n
             assert int(state.n2) == o.n2
             for got, want in (
@@ -399,7 +481,7 @@ def test_vmap_over_independent_lanes():
     P, B = 2, 128
     errs = (rng.random((P, B)) < 0.3).astype(np.float32)
     valid = np.ones((P, B), bool)
-    for name in ("ph", "eddm", "hddm", "hddm_w"):
+    for name in ("ph", "eddm", "hddm", "hddm_w", "adwin"):
         det = make_detector(name, ph=PH, eddm=ED)
         states = jax.vmap(lambda _: det.init())(jnp.arange(P))
         _, res = jax.vmap(det.batch)(states, jnp.asarray(errs), jnp.asarray(valid))
@@ -413,7 +495,7 @@ def test_vmap_over_independent_lanes():
 
 def test_registry_rejects_unknown():
     with pytest.raises(ValueError, match="unknown detector"):
-        make_detector("adwin")
+        make_detector("kswin")
 
 
 def test_ph_alpha_zero_with_padding_matches_spec():
@@ -451,6 +533,46 @@ def test_ph_rejects_alpha_out_of_range():
         ph_batch(ph_init(), e, v, PHParams(alpha=-0.5))
     with pytest.raises(ValueError, match="alpha"):
         ph_window(ph_init(), e.reshape(2, 4), v.reshape(2, 4), PHParams(alpha=1.5))
+
+
+def test_adwin_capacity_forgetting_matches_oracle():
+    """With tiny max_levels the histogram hits capacity and forgets oldest
+    buckets (n lags t, totals adjusted) — kernel and oracle must walk the
+    same bounded window, flags and all, on a drift-free stream."""
+    p = ADWINParams(max_levels=6)  # capacity 5*(2^6-1) = 315 elements
+    rng = np.random.default_rng(11)
+    errs = (rng.random(900) < 0.2).astype(np.float32)
+    valid = np.ones(900, bool)
+    o_warn, o_change, o = oracle_flags(OracleADWIN, p, errs, valid)
+    state, res = adwin_batch(
+        adwin_init(p), jnp.asarray(errs), jnp.asarray(valid), p
+    )
+    fw, fc = firsts(o_warn, o_change)
+    assert int(res.first_change) == fc
+    assert (fc >= 0) or int(state.n) < int(state.t)  # forgetting happened
+    if fc < 0:
+        assert int(state.t) == o.t == 900
+        assert int(state.n) == o.n
+        np.testing.assert_allclose(float(state.total), o.total, rtol=1e-6)
+
+
+def test_adwin_rejects_bad_params():
+    with pytest.raises(ValueError, match="delta"):
+        make_detector("adwin", adwin=ADWINParams(delta=0.0))
+    with pytest.raises(ValueError, match="clock"):
+        make_detector("adwin", adwin=ADWINParams(clock=0))
+    with pytest.raises(ValueError, match="max_levels"):
+        make_detector("adwin", adwin=ADWINParams(max_levels=31))
+    with pytest.raises(ValueError, match="int32"):
+        make_detector("adwin", adwin=ADWINParams(max_levels=30))
+    with pytest.raises(ValueError, match="min_side"):
+        make_detector(
+            "adwin", adwin=ADWINParams(min_window=4, min_side=5)
+        )
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="max_buckets"):
+        adwin_batch(adwin_init(), e, v, ADWINParams(max_buckets=1))
 
 
 def test_hddm_w_rejects_bad_params():
@@ -607,7 +729,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
     """Non-DDM detectors fire near the planted concept boundaries end to end,
@@ -629,7 +751,7 @@ def _sequential_flags(detector):
 
 
 @pytest.mark.parametrize("rotations", [1, 3])
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin"])
 def test_window_engine_matches_sequential(detector, rotations):
     """Window engine == sequential for the zoo members too, at both
     speculation depths (the level loop resets *any* DetectorKernel's state
