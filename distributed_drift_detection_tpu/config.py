@@ -135,25 +135,27 @@ class ADWINParams(NamedTuple):
     Gavaldà 2007 "ADaptive WINdowing").
 
     ``delta`` is the detection confidence of the adaptive-window cut test
-    (smaller = fewer false alarms, longer delay). ``clock`` amortises the
-    cut scan: splits are tested every ``clock``-th absorbed element (the
-    classic implementation's default of 32), so detection positions are
-    quantised to clock boundaries. ``max_buckets`` (the paper's M) bounds
-    the per-level bucket count of the exponential histogram and
-    ``max_levels`` its depth — capacity is ``M·(2^max_levels − 1)``
-    elements (~84 M at the defaults), beyond which the oldest bucket is
-    forgotten (bounded-memory sliding window); the capacity must fit int32
-    (validated), and the absorb counter shares that 2³¹ ceiling per
-    reset-free stream — the engines reset on every change, and the >2³¹
-    soak machinery runs chained legs, so neither limit binds in practice.
-    ``min_window`` / ``min_side`` gate the test on minimum evidence (whole
-    window / either side of a split). All knobs are scale-free — no
-    per-stream auto-resolution is needed."""
+    (smaller = fewer false alarms, longer delay). ``clock`` is both the
+    check cadence *and* the bucket granularity (ops/adwin.py "TPU
+    restructuring"): cuts are tested — and can only land — every
+    ``clock``-th absorbed element (the classic implementations' default
+    check cadence of 32), and a level-k histogram bucket spans
+    ``clock·2^k`` elements. ``max_buckets`` (the paper's M) bounds the
+    per-level bucket count and ``max_levels`` the depth — capacity is
+    ``M·clock·(2^max_levels − 1)`` elements (~168 M at the defaults),
+    beyond which the oldest bucket is forgotten (bounded-memory sliding
+    window); the capacity must fit int32 (validated), and the absorb
+    counter shares that 2³¹ ceiling per reset-free stream — the engines
+    reset on every change, and the >2³¹ soak machinery runs chained legs,
+    so neither limit binds in practice. ``min_window`` / ``min_side``
+    gate the test on minimum evidence (whole window / either side of a
+    split). All knobs are scale-free — no per-stream auto-resolution is
+    needed."""
 
     delta: float = 0.002
     clock: int = 32
     max_buckets: int = 5
-    max_levels: int = 24
+    max_levels: int = 20
     min_window: int = 10
     min_side: int = 5
 

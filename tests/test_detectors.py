@@ -268,15 +268,19 @@ class OracleHDDMW:
 
 class OracleADWIN:
     """Independent per-element ADWIN (Bifet & Gavaldà 2007) mirroring the
-    kernel's documented spec (ops/adwin.py): exponential histogram with M
-    buckets/level merged oldest-first, capacity forgetting at the top
-    level, clocked cut scan with ε_cut = sqrt(2/m·σ²·ln(2/δ′)) +
-    2/(3m)·ln(2/δ′), δ′ = δ/n, σ² = p(1−p) (Bernoulli inputs)."""
+    kernel's documented chunked spec (ops/adwin.py "TPU restructuring"):
+    elements buffer into a ``clock``-sized pending chunk; each completed
+    chunk becomes a level-0 bucket (a level-k bucket spans clock·2^k
+    elements), M buckets/level merged oldest-first, capacity forgetting at
+    the top level, and a cut scan per flush with ε_cut =
+    sqrt(2/m·σ²·ln(2/δ′)) + 2/(3m)·ln(2/δ′), δ′ = δ/n, σ² = p(1−p)
+    (Bernoulli inputs)."""
 
     def __init__(self, p: ADWINParams):
         self.p = p
         self.t = 0
-        self.n = 0
+        self.pend_sum = 0.0
+        self.n = 0  # bucketed elements only
         self.total = 0.0
         self.levels = [[] for _ in range(p.max_levels)]  # sums, oldest first
         self.in_warning = False
@@ -288,21 +292,26 @@ class OracleADWIN:
         p = self.p
         L, M = p.max_levels, p.max_buckets
         self.t += 1
-        self.n += 1
-        self.total += x
-        self.levels[0].append(x)
+        self.pend_sum += x
+        self.in_change = self.in_warning = False
+        if self.t % p.clock:
+            return
+        # Flush the completed chunk as a level-0 bucket.
+        self.n += p.clock
+        self.total += self.pend_sum
+        self.levels[0].append(self.pend_sum)
+        self.pend_sum = 0.0
         for k in range(L):
             if len(self.levels[k]) > M:
                 if k == L - 1:  # capacity: forget the oldest bucket
                     old = self.levels[k].pop(0)
-                    self.n -= 1 << k
+                    self.n -= p.clock * (1 << k)
                     self.total -= old
                 else:
                     a = self.levels[k].pop(0)
                     b = self.levels[k].pop(0)
                     self.levels[k + 1].append(a + b)
-        self.in_change = self.in_warning = False
-        if self.t % p.clock or self.n < p.min_window:
+        if self.n < p.min_window:
             return
         mean = self.total / self.n
         var = mean * (1.0 - mean)
@@ -310,7 +319,7 @@ class OracleADWIN:
         n0, s0 = 0, 0.0
         for k in reversed(range(L)):
             for sm in self.levels[k]:
-                n0 += 1 << k
+                n0 += p.clock * (1 << k)
                 s0 += sm
                 n1 = self.n - n0
                 if n0 < p.min_side or n1 < p.min_side:
@@ -398,6 +407,9 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
             assert int(state.t) == o.t
             assert int(state.n) == o.n
             np.testing.assert_allclose(float(state.total), o.total, rtol=1e-6)
+            np.testing.assert_allclose(
+                float(state.pend_sum), o.pend_sum, rtol=1e-6, atol=1e-6
+            )
             counts = [len(lv) for lv in o.levels]
             np.testing.assert_array_equal(np.asarray(state.counts), counts)
             for k, lv in enumerate(o.levels):
@@ -539,7 +551,7 @@ def test_adwin_capacity_forgetting_matches_oracle():
     """With tiny max_levels the histogram hits capacity and forgets oldest
     buckets (n lags t, totals adjusted) — kernel and oracle must walk the
     same bounded window, flags and all, on a drift-free stream."""
-    p = ADWINParams(max_levels=6)  # capacity 5*(2^6-1) = 315 elements
+    p = ADWINParams(max_levels=3, clock=4)  # capacity 5*4*(2^3-1) = 140
     rng = np.random.default_rng(11)
     errs = (rng.random(900) < 0.2).astype(np.float32)
     valid = np.ones(900, bool)
