@@ -14,6 +14,7 @@ from .config import (
     ADWINParams,
     HDDMParams,
     HDDMWParams,
+    KSWINParams,
     PHParams,
     RunConfig,
     replace,
@@ -45,6 +46,7 @@ __all__ = [
     "ADWINParams",
     "HDDMParams",
     "HDDMWParams",
+    "KSWINParams",
     "PHParams",
     "RunConfig",
     "replace",

@@ -102,6 +102,7 @@ def _cached_runner(
                 hddm=cfg.hddm,
                 hddm_w=cfg.hddm_w,
                 adwin=cfg.adwin,
+                kswin=cfg.kswin,
             ),
             rotations=cfg.window_rotations,
         )
@@ -114,7 +115,7 @@ def _cached_runner(
         cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
         cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w, cfg.adwin,
-        cfg.window_rotations,
+        cfg.kswin, cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

@@ -160,10 +160,33 @@ class ADWINParams(NamedTuple):
     min_side: int = 5
 
 
+class KSWINParams(NamedTuple):
+    """KSWIN hyper-parameters (detector='kswin', ops/detectors.py; Raab,
+    Heusinger & Schleif 2020 defaults).
+
+    A sliding window of the last ``window_size`` error indicators is split
+    into its newest ``stat_size`` elements and the remainder; change fires
+    when the two-sample Kolmogorov–Smirnov test rejects at significance
+    ``alpha``. On Bernoulli inputs the KS statistic degenerates to the
+    proportion gap ``|p̂_recent − p̂_old|`` (the module docstring derives
+    this), so the whole test is a rolling-mean comparison against the
+    closed-form KS critical value — no empirical CDFs needed. Two
+    documented deviations from the reference implementation: the "old"
+    sample is the *entire* older window rather than a ``stat_size``-sized
+    uniform subsample (the subsample exists to cheapen a host KS test;
+    here the full comparison is free and strictly lower-variance), and
+    the decision uses the asymptotic critical-value form of the test
+    rather than the exact p-value."""
+
+    alpha: float = 0.005
+    window_size: int = 100
+    stat_size: int = 30
+
+
 # Valid RunConfig.detector values (kernels in ops/detectors.py +
 # ops/adwin.py). Lives here, not in ops/, so jax-free consumers (the grid
 # harness CLI) can validate without initialising a backend.
-DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin")
+DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,10 +214,11 @@ class RunConfig:
     # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' |
     # 'hddm' (HDDM-A, Hoeffding-bound) | 'hddm_w' (HDDM-W, its EWMA
     # companion) | 'adwin' (adaptive windowing; the zoo's only
-    # scan-of-steps kernel — see ops/adwin.py) — the detector zoo,
-    # ops/detectors.py. Non-DDM detectors are a framework extension: the
-    # reference only ships DDM, so cross-reference parity claims (delay
-    # tables, oracle goldens) hold for detector='ddm'.
+    # scan-of-steps kernel — see ops/adwin.py) | 'kswin' (sliding-window
+    # KS test) — the detector zoo, ops/detectors.py. Non-DDM detectors
+    # are a framework extension: the reference only ships DDM, so
+    # cross-reference parity claims (delay tables, oracle goldens) hold
+    # for detector='ddm'.
     detector: str = "ddm"
     ddm: DDMParams = DDMParams()
     ph: PHParams = PHParams()
@@ -202,6 +226,7 @@ class RunConfig:
     hddm: HDDMParams = HDDMParams()
     hddm_w: HDDMWParams = HDDMWParams()
     adwin: ADWINParams = ADWINParams()
+    kswin: KSWINParams = KSWINParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

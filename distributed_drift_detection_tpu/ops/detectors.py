@@ -3,10 +3,11 @@
 The reference is a single-detector artifact — its only statistic is
 skmultiflow's ``DDM`` (``DDM_Process.py:133,139``; rebuilt TPU-native in
 ``ops.ddm``). A drift-detection *framework* owes its users the standard
-alternatives, so this module adds four classic error-stream detectors (a
-fifth, adaptive windowing, lives in ``ops.adwin`` — structurally a
+alternatives, so this module adds five classic error-stream detectors (a
+sixth, adaptive windowing, lives in ``ops.adwin`` — structurally a
 different beast) and a uniform :class:`DetectorKernel` seam the engines
-consume:
+consume — together the registry covers every detector in skmultiflow's
+``drift_detection`` module (DDM, EDDM, HDDM-A/W, PH, ADWIN, KSWIN):
 
 * **Page–Hinkley** (:func:`ph_batch`) — the clamped CUSUM test (Page 1954;
   the streaming form popularised by Gama et al.'s drift surveys): per error
@@ -73,7 +74,16 @@ consume:
   implemented. Both knobs are scale-free confidences, so ``hddm`` needs no
   per-stream auto-resolution (contrast ``ph``'s λ).
 
-All four are implemented exactly like ``ops.ddm_batch``: the whole microbatch
+* **KSWIN** (:func:`kswin_batch`) — sliding-window Kolmogorov–Smirnov test
+  (Raab, Heusinger & Schleif 2020): the newest ``stat_size`` of the last
+  ``window_size`` elements against the older remainder, change when the KS
+  test rejects at ``alpha``. On the engines' Bernoulli error indicators
+  the KS statistic *is* the proportion gap (the empirical CDFs step only
+  at 0), so the kernel is a rolling-mean comparison against the
+  closed-form critical value — see :func:`kswin_step` and the two
+  documented deviations in :class:`config.KSWINParams`.
+
+All five are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
 sums for the running statistics and an ``associative_scan`` for the
 sequential part. For Page–Hinkley the recurrence ``m → max(0, α·m + c)`` is
@@ -87,7 +97,10 @@ prefix as payload — the same min-with-payload associative combine as DDM's
 *affine map* ``y → Ay + B`` (the two EWMAs, their weight sums, with reset /
 initialise expressed as ``A = 0``), and affine maps compose associatively —
 the cut positions are a running strict min of a key computable from prefix
-statistics alone, which then segments the second EWMA's resets.
+statistics alone, which then segments the second EWMA's resets. KSWIN is
+the degenerate case: its windowed statistic needs no scan of any kind —
+every position's two window means are differences of one prefix-sum
+vector over the valid-compacted batch.
 
 State-reset protocol matches the engines' DDM contract (``ops.ddm``): the
 *caller* resets on change (the reference discards its detector at
@@ -115,6 +128,7 @@ from ..config import (
     EDDMParams,
     HDDMParams,
     HDDMWParams,
+    KSWINParams,
     PHParams,
 )
 from .ddm import (
@@ -840,6 +854,159 @@ def hddm_w_window(
 
 
 # --------------------------------------------------------------------------
+# KSWIN
+# --------------------------------------------------------------------------
+
+
+class KSWINState(NamedTuple):
+    """Carried KSWIN state (fixed shapes; vmap adds axes).
+
+    ``buf[w]`` holds the last ``min(t, w)`` valid error indicators
+    *right-aligned* (newest at index w−1); slots left of ``w − t`` are
+    zero-padding that no gated test can reach. ``t`` counts elements
+    absorbed since reset."""
+
+    t: jax.Array  # i32: elements absorbed since reset
+    buf: jax.Array  # f32 [window_size]: last w elements, right-aligned
+
+
+def kswin_init(params: KSWINParams = KSWINParams()) -> KSWINState:
+    return KSWINState(
+        jnp.int32(0), jnp.zeros((params.window_size,), jnp.float32)
+    )
+
+
+def _validate_kswin(params: KSWINParams) -> None:
+    """Reject out-of-range concrete params at every public kernel entry
+    (the ``_validate_ph`` pattern; like ADWIN's these size arrays, so
+    there is no traced-params path to wave through)."""
+    if not 0.0 < float(params.alpha) < 1.0:
+        raise ValueError(
+            f"KSWINParams.alpha must be in (0, 1), got {params.alpha}"
+        )
+    if not 0 < int(params.stat_size) < int(params.window_size):
+        raise ValueError(
+            "KSWINParams needs 0 < stat_size < window_size, got "
+            f"stat_size={params.stat_size}, window_size={params.window_size}"
+        )
+
+
+def _kswin_crit(params: KSWINParams) -> float:
+    """Closed-form two-sample KS critical value at significance α:
+    c(α)·sqrt((n₁+n₂)/(n₁·n₂)) with c(α) = sqrt(−ln(α/2)/2), n₁ =
+    stat_size (recent), n₂ = window_size − stat_size (older). A Python
+    float — the whole decision boundary is a trace-time constant."""
+    import math
+
+    r = int(params.stat_size)
+    m = int(params.window_size) - r
+    c = math.sqrt(-math.log(float(params.alpha) / 2.0) / 2.0)
+    return c * math.sqrt((r + m) / (r * m))
+
+
+def kswin_step(
+    state: KSWINState, err: jax.Array, params: KSWINParams = KSWINParams()
+) -> tuple[KSWINState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec): shift the window, then — once it is
+    full — compare the newest ``stat_size`` elements' mean against the
+    older remainder's mean at the KS critical value.
+
+    Why a mean comparison *is* the KS test here: the engines feed 0/1
+    error indicators, whose empirical CDF steps only at 0 — so the KS
+    statistic ``sup_x |F₁(x) − F₂(x)|`` is exactly ``|(1−p̂₁) − (1−p̂₂)| =
+    |p̂₁ − p̂₂|``. No warning zone (the reference implementation reports
+    none); ``warning`` is constantly False.
+    """
+    _validate_kswin(params)
+    w, r = int(params.window_size), int(params.stat_size)
+    m = w - r
+    buf = jnp.roll(state.buf, -1).at[-1].set(err.astype(jnp.float32))
+    t = state.t + 1
+    p_recent = jnp.sum(buf[m:]) / r
+    p_old = jnp.sum(buf[:m]) / m
+    change = (t >= w) & (
+        jnp.abs(p_recent - p_old) > jnp.float32(_kswin_crit(params))
+    )
+    return KSWINState(t, buf), (jnp.bool_(False), change)
+
+
+def _kswin_masks(
+    state: KSWINState, errs: jax.Array, valid: jax.Array, params: KSWINParams
+):
+    """Flat ``[N]`` pass → ``(end_state, warning[N], change[N])``.
+
+    Fully vectorised — the zoo's only *windowed* statistic needs no scan
+    at all: compact the valid elements, concatenate the carried window,
+    and every position's recent/old sums are two differences of one
+    prefix-sum vector. The new carried window is a dynamic slice."""
+    _validate_kswin(params)
+    w, r = int(params.window_size), int(params.stat_size)
+    m = w - r
+    n_el = errs.shape[0]
+
+    # Compact valid elements into consecutive slots (invalid → drop bin).
+    vcnt = jnp.cumsum(valid.astype(jnp.int32))
+    nv = vcnt[-1]
+    slot = jnp.where(valid, vcnt - 1, n_el)
+    compact = (
+        jnp.zeros((n_el + 1,), jnp.float32)
+        .at[slot]
+        .set(errs.astype(jnp.float32) * valid)[:n_el]
+    )
+
+    full = jnp.concatenate([state.buf, compact])  # [w + N]
+    ps = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32), jnp.cumsum(full)]
+    )  # ps[k] = sum(full[:k])
+
+    # Valid element j-th in compaction order sits at full-index w + j; its
+    # window is full[(j+1) .. (w+j)] — recent r, then the older m.
+    j = jnp.clip(vcnt - 1, 0, n_el - 1)
+    hi = ps[w + j + 1]
+    mid = ps[w + j + 1 - r]
+    lo = ps[j + 1]
+    p_recent = (hi - mid) / r
+    p_old = (mid - lo) / m
+    t_at = state.t + vcnt
+    change = (
+        valid
+        & (t_at >= w)
+        & (jnp.abs(p_recent - p_old) > jnp.float32(_kswin_crit(params)))
+    )
+    warning = jnp.zeros_like(change)
+
+    end_state = KSWINState(
+        state.t + nv, lax.dynamic_slice_in_dim(full, nv, w)
+    )
+    return end_state, warning, change
+
+
+def kswin_batch(
+    state: KSWINState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: KSWINParams = KSWINParams(),
+) -> tuple[KSWINState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _kswin_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def kswin_window(
+    state: KSWINState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: KSWINParams = KSWINParams(),
+) -> tuple[KSWINState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _kswin_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -852,6 +1019,7 @@ def make_detector(
     hddm: HDDMParams = HDDMParams(),
     hddm_w: HDDMWParams = HDDMWParams(),
     adwin: ADWINParams = ADWINParams(),
+    kswin: KSWINParams = KSWINParams(),
 ) -> DetectorKernel:
     """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
     if name == "ddm":
@@ -925,6 +1093,15 @@ def make_detector(
             lambda s, e, v: adwin_batch(s, e, v, adwin),
             lambda s, e, v: adwin_window(s, e, v, adwin),
             adwin,
+        )
+    if name == "kswin":
+        _validate_kswin(kswin)
+        return DetectorKernel(
+            "kswin",
+            lambda: kswin_init(kswin),
+            lambda s, e, v: kswin_batch(s, e, v, kswin),
+            lambda s, e, v: kswin_window(s, e, v, kswin),
+            kswin,
         )
     raise ValueError(
         f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"

@@ -1,12 +1,14 @@
-"""Compare the four drift detectors on one planted-drift stream.
+"""Compare the seven drift detectors on one planted-drift stream.
 
 The reference ships a single statistic (skmultiflow's DDM,
-``DDM_Process.py:133``); this framework adds Page–Hinkley, EDDM and HDDM-A
-behind the same engine seam (``ops/detectors.py``). This example runs all
-four on the same stream/model/seed and reports boundary-attributed quality
-side by side — detections decomposed into first hits vs spurious extra
-fires, with recall and hit-based delay (``metrics.attribution_metrics``) —
-the quickest way to see how their sensitivity profiles differ.
+``DDM_Process.py:133``); this framework adds Page–Hinkley, EDDM, HDDM-A,
+HDDM-W, ADWIN and KSWIN — the full skmultiflow ``drift_detection`` zoo —
+behind the same engine seam (``ops/detectors.py`` + ``ops/adwin.py``).
+This example runs all seven on the same stream/model/seed and reports
+boundary-attributed quality side by side — detections decomposed into
+first hits vs spurious extra fires, with recall and hit-based delay
+(``metrics.attribution_metrics``) — the quickest way to see how their
+sensitivity profiles differ.
 
     python examples/detector_zoo.py [dataset.csv] [mult] [partitions]
 """
@@ -36,7 +38,7 @@ def main():
 
     print(f"{'detector':<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
           f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
-    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin"):
+    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"):
         res = run(replace(base, detector=name))
         m = res.metrics
         a = attribution_metrics(

@@ -19,6 +19,7 @@ from jax import lax
 from distributed_drift_detection_tpu.config import (
     ADWINParams,
     EDDMParams,
+    KSWINParams,
     HDDMParams,
     HDDMWParams,
     PHParams,
@@ -44,6 +45,10 @@ from distributed_drift_detection_tpu.ops.detectors import (
     hddm_w_step,
     hddm_w_window,
     hddm_window,
+    kswin_batch,
+    kswin_init,
+    kswin_step,
+    kswin_window,
     ph_batch,
     ph_init,
     ph_step,
@@ -334,6 +339,42 @@ class OracleADWIN:
                     return
 
 
+class OracleKSWIN:
+    """Independent per-element KSWIN (Raab et al. 2020, as specced in
+    ops/detectors.py): sliding window of the last window_size elements,
+    newest stat_size vs the older remainder, change when the proportion
+    gap (= the KS statistic on Bernoulli inputs) exceeds the closed-form
+    critical value."""
+
+    def __init__(self, p: KSWINParams):
+        import math
+
+        self.p = p
+        self.t = 0
+        self.buf = []  # last window_size elements, oldest first
+        r = p.stat_size
+        m = p.window_size - r
+        c = math.sqrt(-math.log(p.alpha / 2.0) / 2.0)
+        self.crit = c * math.sqrt((r + m) / (r * m))
+        self.in_warning = False
+        self.in_change = False
+
+    def add_element(self, x: float) -> None:
+        p = self.p
+        self.t += 1
+        self.buf.append(x)
+        if len(self.buf) > p.window_size:
+            self.buf.pop(0)
+        self.in_change = self.in_warning = False
+        if self.t < p.window_size:
+            return
+        r = p.stat_size
+        m = p.window_size - r
+        recent = sum(self.buf[m:]) / r
+        old = sum(self.buf[:m]) / m
+        self.in_change = abs(recent - old) > self.crit
+
+
 def oracle_flags(oracle_cls, params, errs, valid):
     o = oracle_cls(params)
     warn = np.zeros(len(errs), bool)
@@ -370,6 +411,9 @@ HW = HDDMWParams()
 # = 20k elements) still exceeds every CASES stream, so forgetting is
 # exercised by its own test below, not silently here.
 AD = ADWINParams(max_levels=12)
+# Small enough that the 96-element fuzz streams and 256-element CASES
+# streams exercise full-window testing, not just warm-up.
+KW = KSWINParams(window_size=40, stat_size=10)
 
 CASES = [
     ("ph", OraclePH, PH, ph_init, ph_step, ph_batch, ph_window),
@@ -383,6 +427,8 @@ CASES = [
      hddm_w_init, hddm_w_step, hddm_w_batch, hddm_w_window),
     ("adwin", OracleADWIN, AD,
      lambda: adwin_init(AD), adwin_step, adwin_batch, adwin_window),
+    ("kswin", OracleKSWIN, KW,
+     lambda: kswin_init(KW), kswin_step, kswin_batch, kswin_window),
 ]
 
 
@@ -403,7 +449,11 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
     assert int(res.first_change) == fc
     assert int(res.first_warning) == fw
     if fc < 0:  # end state only meaningful when no change fired
-        if name == "adwin":
+        if name == "kswin":
+            assert int(state.t) == o.t
+            got = np.asarray(state.buf)[-len(o.buf):] if o.buf else []
+            np.testing.assert_allclose(got, o.buf, rtol=1e-6)
+        elif name == "adwin":
             assert int(state.t) == o.t
             assert int(state.n) == o.n
             np.testing.assert_allclose(float(state.total), o.total, rtol=1e-6)
@@ -493,7 +543,7 @@ def test_vmap_over_independent_lanes():
     P, B = 2, 128
     errs = (rng.random((P, B)) < 0.3).astype(np.float32)
     valid = np.ones((P, B), bool)
-    for name in ("ph", "eddm", "hddm", "hddm_w", "adwin"):
+    for name in ("ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"):
         det = make_detector(name, ph=PH, eddm=ED)
         states = jax.vmap(lambda _: det.init())(jnp.arange(P))
         _, res = jax.vmap(det.batch)(states, jnp.asarray(errs), jnp.asarray(valid))
@@ -507,7 +557,7 @@ def test_vmap_over_independent_lanes():
 
 def test_registry_rejects_unknown():
     with pytest.raises(ValueError, match="unknown detector"):
-        make_detector("kswin")
+        make_detector("ecdd")
 
 
 def test_ph_alpha_zero_with_padding_matches_spec():
@@ -585,6 +635,17 @@ def test_adwin_rejects_bad_params():
     v = jnp.ones(8, bool)
     with pytest.raises(ValueError, match="max_buckets"):
         adwin_batch(adwin_init(), e, v, ADWINParams(max_buckets=1))
+
+
+def test_kswin_rejects_bad_params():
+    with pytest.raises(ValueError, match="alpha"):
+        make_detector("kswin", kswin=KSWINParams(alpha=0.0))
+    with pytest.raises(ValueError, match="stat_size"):
+        make_detector("kswin", kswin=KSWINParams(window_size=30, stat_size=30))
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="stat_size"):
+        kswin_batch(kswin_init(), e, v, KSWINParams(stat_size=0))
 
 
 def test_hddm_w_rejects_bad_params():
@@ -741,7 +802,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
     """Non-DDM detectors fire near the planted concept boundaries end to end,
@@ -763,7 +824,7 @@ def _sequential_flags(detector):
 
 
 @pytest.mark.parametrize("rotations", [1, 3])
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"])
 def test_window_engine_matches_sequential(detector, rotations):
     """Window engine == sequential for the zoo members too, at both
     speculation depths (the level loop resets *any* DetectorKernel's state
