@@ -112,7 +112,8 @@ def _cached_runner(
         return build()  # never cached: closures pin host-side fitted state
     key = (
         cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
-        cfg.mlp_learning_rate, cfg.per_batch, cfg.partitions, spec, cfg.ddm,
+        cfg.mlp_learning_rate, cfg.forest_trees, cfg.forest_depth,
+        cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
         cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w, cfg.adwin,
         cfg.kswin, cfg.window_rotations,

@@ -170,13 +170,17 @@ class KSWINParams(NamedTuple):
     ``alpha``. On Bernoulli inputs the KS statistic degenerates to the
     proportion gap ``|p̂_recent − p̂_old|`` (the module docstring derives
     this), so the whole test is a rolling-mean comparison against the
-    closed-form KS critical value — no empirical CDFs needed. Two
+    closed-form KS critical value — no empirical CDFs needed. Three
     documented deviations from the reference implementation: the "old"
     sample is the *entire* older window rather than a ``stat_size``-sized
     uniform subsample (the subsample exists to cheapen a host KS test;
-    here the full comparison is free and strictly lower-variance), and
-    the decision uses the asymptotic critical-value form of the test
-    rather than the exact p-value."""
+    here the full comparison is free and strictly lower-variance); the
+    decision uses the asymptotic critical-value form of the test rather
+    than the exact p-value; and on detection the window is *emptied* (the
+    framework's uniform caller-reset contract — the engines discard
+    detector state and retrain) rather than retaining the newest
+    ``stat_size`` elements, so re-arming after a change takes a full
+    ``window_size`` warm-up instead of ``window_size − stat_size``."""
 
     alpha: float = 0.005
     window_size: int = 100
@@ -201,10 +205,11 @@ class RunConfig:
     # --- loop (reference C7, DDM_Process.py:162-213) ---
     per_batch: int = 100
     shuffle_batches: bool = True  # seeded analog of .sample(frac=1) at :187,190
-    # 'majority' | 'centroid' | 'gnb' | 'linear' | 'mlp' | 'rf' ('rf' is the
-    # host-callback reference-parity RandomForest, models/rf.py; like 'mlp'
-    # its fit consumes a PRNG key, so rf flags are seed-equivalent but not
-    # bit-equal across different `window` values). 'centroid' is the
+    # 'majority' | 'centroid' | 'gnb' | 'linear' | 'mlp' | 'forest' | 'rf'
+    # ('forest' is the on-device extremely-randomized oblique forest; 'rf'
+    # is the host-callback reference-parity RandomForest, models/rf.py;
+    # like 'mlp' their fits consume a PRNG key, so their flags are
+    # seed-equivalent but not bit-equal across different `window` values). 'centroid' is the
     # documented flagship (PARITY.md: closed-form fit, rf-grade delay) and
     # what bench.py measures; 'linear' over-fires ~15× on rialto-like
     # regimes, so it is deliberately not the default.
@@ -284,6 +289,11 @@ class RunConfig:
     # model='rf' (host-callback parity path, models/rf.py): forest size; the
     # reference uses sklearn's default 100 trees (DDM_Process.py:102).
     rf_estimators: int = 100
+    # model='forest' (on-device extremely-randomized oblique forest,
+    # models/classifiers.py make_forest): ensemble size and complete-tree
+    # depth (2^depth leaves per tree).
+    forest_trees: int = 32
+    forest_depth: int = 3
 
     # --- execution ---
     backend: str = "jax"  # 'jax' | 'spark' (stub seam, see api.py)

@@ -16,6 +16,9 @@ All families are pure pytrees (see ``base.py`` for the contract):
 * ``mlp`` — MLP with configurable hidden widths (default (128, 64), the
   BASELINE.json "Per-partition MLP(128,64)" config), fitted with K SGD +
   momentum steps.
+* ``forest`` — extremely-randomized *oblique* forest fitted entirely on
+  device (no host callback): random-projection splits make every tree a
+  column block of one matmul; see :func:`make_forest`.
 
 Fits run inside ``lax.scan``/``vmap``, so they must be cheap, fixed-shape,
 and key-driven. Class count is static (inferred from the dataset).
@@ -300,6 +303,140 @@ def make_mlp(
 
 
 # --------------------------------------------------------------------------
+# extremely-randomized oblique forest (on-device trees)
+# --------------------------------------------------------------------------
+
+
+class ForestParams(NamedTuple):
+    proj: jax.Array  # [F, T·(2^d − 1)]: oblique node projections
+    thresh: jax.Array  # [T·(2^d − 1)]: node thresholds
+    leaf_class: jax.Array  # [T, 2^d] i32: majority class per leaf
+
+
+def make_forest(spec: ModelSpec, *, trees: int = 32, depth: int = 3) -> Model:
+    """Extremely-randomized *oblique* forest, fitted entirely on device.
+
+    The TPU-native answer to the reference's ``RandomForestClassifier``
+    (C4, ``DDM_Process.py:96-105``) beyond the host-callback parity path
+    (``models/rf.py``): axis-aligned greedy tree induction is hostile to
+    the MXU (data-dependent shapes, per-node argmin loops), but the
+    *extremely-randomized* end of the forest family (Geurts et al. 2006)
+    needs no search at all — draw split directions and thresholds at
+    random, and let averaging over many trees do the work. Two further
+    moves make it matmul-shaped: splits are **oblique** (random Gaussian
+    projections of all features, so every tree's every node is one column
+    of a single ``[B,F]×[F,T·nodes]`` matmul — MXU food — and oblique
+    random splits are strictly more expressive than axis-aligned ones at
+    equal depth), and trees are **complete and fixed-depth** (heap-indexed
+    routing = ``depth`` gather/compare rounds, no ragged structure).
+    Thresholds are random quantiles (u ∈ [0.1, 0.9]) of each node's
+    projected *batch* distribution — the classic ERT draw, computed from
+    the root sample for every node so shapes stay static; deeper nodes
+    therefore split on unconditioned quantiles, which costs some per-node
+    discrimination and is repaid by the ensemble vote. Leaves predict
+    their majority class (empty leaves fall back to the batch majority);
+    the forest predicts the modal leaf vote.
+
+    Like ``mlp``, the fit consumes its PRNG key (fresh projections every
+    fit), so flags are seed-equivalent but not bit-equal across execution
+    policies that re-key fits differently (window/rotations — see the
+    ``RunConfig.window`` caveat).
+
+    **Measured domain limit (r04, results/delay_parity.csv):** on
+    outdoorStream ×64 the forest is boundary-perfect (delay 4.0 ± 0.1
+    global batches, recall 1.000, zero spurious — indistinguishable from
+    the rf/centroid families). On the rialto stand-in it shares gnb's
+    documented failure class: trees *memorise* their training batch, so a
+    fit carries ≈ zero accuracy across a concept boundary, and one
+    DDM reset at a bad position (a handful of hard rows that every family
+    mispredicts fire DDM's zero-tolerance ``p_min = 0`` regime just before
+    the first boundary) lands the detector in its pinned-``p_min``
+    blindspot with a model that will never recover accuracy — recall 0
+    from a single stray fire. Smooth-boundary families (centroid/mlp)
+    escape because their old-concept fit still gets a fraction of
+    new-concept rows right, keeping the minima off the ceiling. The
+    measured mitigation is the reference's own (dead) REGRESSION_THRESH
+    idea: ``RunConfig(retrain_error_threshold=0.3)`` forces a refit in
+    saturated-error regimes and returns rialto recall to 0.889.
+    """
+    if trees < 1:
+        raise ValueError(f"forest_trees must be >= 1, got {trees}")
+    if not 1 <= depth <= 16:
+        raise ValueError(
+            f"forest_depth must be in [1, 16] (2^depth leaves per tree), "
+            f"got {depth}"
+        )
+    f, c = spec.num_features, spec.num_classes
+    n_nodes = (1 << depth) - 1
+    n_leaves = 1 << depth
+    tree_idx = jnp.arange(trees)
+
+    def init(key):
+        return ForestParams(
+            jnp.zeros((f, trees * n_nodes), jnp.float32),
+            jnp.zeros((trees * n_nodes,), jnp.float32),
+            jnp.zeros((trees, n_leaves), jnp.int32),
+        )
+
+    def _route(proj, thresh, X):
+        """Heap-indexed routing: node i's children are 2i+1 / 2i+2; after
+        ``depth`` rounds the index lands in the leaf block, whose offset is
+        ``n_nodes``. Returns leaf ids ``[B, T]``."""
+        b = X.shape[0]
+        z = (X @ proj).reshape(b, trees, n_nodes)
+        th = thresh.reshape(trees, n_nodes)
+        node = jnp.zeros((b, trees), jnp.int32)
+        for _ in range(depth):
+            zv = jnp.take_along_axis(z, node[:, :, None], axis=2)[:, :, 0]
+            tv = th[tree_idx[None, :], node]
+            node = 2 * node + 1 + (zv > tv).astype(jnp.int32)
+        return node - n_nodes
+
+    def fit(key, X, y, w):
+        kp, kt = jax.random.split(key)
+        b = X.shape[0]
+        proj = jax.random.normal(
+            kp, (f, trees * n_nodes), jnp.float32
+        ) / jnp.sqrt(jnp.float32(f))
+        z = X @ proj  # [B, T·nodes]
+        # ERT threshold draw: a random quantile of each node's projected
+        # values over the valid rows (invalid rows sort to the end as +inf;
+        # an all-invalid batch yields +inf thresholds → everything routes
+        # left, and the all-zero leaf counts fall back to batch majority).
+        zs = jnp.sort(jnp.where(w[:, None] > 0, z, jnp.inf), axis=0)
+        nv = jnp.maximum(jnp.sum(w), 1.0)
+        u = jax.random.uniform(
+            kt, (trees * n_nodes,), minval=0.1, maxval=0.9
+        )
+        idx = jnp.clip((u * nv).astype(jnp.int32), 0, b - 1)
+        thresh = jnp.take_along_axis(zs, idx[None, :], axis=0)[0]
+
+        leaf = _route(proj, thresh, X)  # [B, T]
+        counts = (
+            jnp.zeros((trees, n_leaves, c), jnp.float32)
+            .at[tree_idx[None, :], leaf, y[:, None]]
+            .add(w[:, None])
+        )
+        totals = jnp.sum(counts, axis=-1)  # [T, L]
+        batch_major = jnp.argmax(
+            jnp.zeros(c, jnp.float32).at[y].add(w)
+        ).astype(jnp.int32)
+        leaf_class = jnp.where(
+            totals > 0, jnp.argmax(counts, axis=-1).astype(jnp.int32), batch_major
+        )
+        return ForestParams(proj, thresh, leaf_class)
+
+    def predict(params, X):
+        leaf = _route(params.proj, params.thresh, X)
+        votes = params.leaf_class[tree_idx[None, :], leaf]  # [B, T]
+        tally = jnp.sum(jax.nn.one_hot(votes, c, dtype=jnp.float32), axis=1)
+        # argmax ties resolve to the lowest class (the majority-model rule)
+        return jnp.argmax(tally, axis=-1).astype(jnp.int32)
+
+    return Model("forest", init, fit, predict)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -322,6 +459,10 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
         hidden = tuple(cfg.mlp_hidden) if cfg is not None else (128, 64)
         lr = cfg.mlp_learning_rate if cfg is not None else 0.05
         return make_mlp(spec, hidden=hidden, learning_rate=lr, **kw)
+    if name == "forest":
+        trees = cfg.forest_trees if cfg is not None else 32
+        depth = cfg.forest_depth if cfg is not None else 3
+        return make_forest(spec, trees=trees, depth=depth)
     if name == "rf":
         from .rf import make_rf
 
@@ -337,5 +478,6 @@ def build_model(name: str, spec: ModelSpec, cfg=None) -> Model:
             cache_size=max(64, 2 * parts),
         )
     raise ValueError(
-        f"unknown model {name!r}; expected majority|centroid|gnb|linear|mlp|rf"
+        f"unknown model {name!r}; expected "
+        "majority|centroid|gnb|linear|mlp|forest|rf"
     )

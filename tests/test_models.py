@@ -18,7 +18,7 @@ def separable_batch(rng, n=100, classes=5, f=8):
     return jnp.asarray(X), jnp.asarray(y)
 
 
-@pytest.mark.parametrize("name", ["majority", "centroid", "gnb", "linear", "mlp"])
+@pytest.mark.parametrize("name", ["majority", "centroid", "gnb", "linear", "mlp", "forest"])
 def test_fit_predict_roundtrip(name):
     rng = np.random.default_rng(0)
     model = build_model(name, SPEC)
@@ -34,7 +34,7 @@ def test_fit_predict_roundtrip(name):
         assert err < 0.05, f"{name} train error {err}"
 
 
-@pytest.mark.parametrize("name", ["centroid", "gnb", "linear", "mlp"])
+@pytest.mark.parametrize("name", ["centroid", "gnb", "linear", "mlp", "forest"])
 def test_generalizes_to_same_distribution(name):
     rng = np.random.default_rng(1)
     protos = rng.normal(size=(5, 8)).astype(np.float32) * 3
@@ -66,7 +66,7 @@ def test_weight_mask_excludes_padding():
     )
 
 
-@pytest.mark.parametrize("name", ["centroid", "gnb"])
+@pytest.mark.parametrize("name", ["centroid", "gnb", "forest"])
 def test_absent_class_never_predicted(name):
     model = build_model(name, SPEC)
     X = jnp.zeros((20, 8))
@@ -147,3 +147,32 @@ def test_gnb_beats_centroid_on_anisotropic_classes():
     err_c = float((np.asarray(cen.predict(pc, jnp.asarray(Xq))) != yq).mean())
     assert err_g < 0.1
     assert err_g < err_c
+
+
+def test_forest_same_key_is_deterministic():
+    """forest's fit consumes its PRNG key (fresh projections per fit) —
+    same key, same data => bit-identical params; different key => a
+    different (but still accurate) ensemble."""
+    rng = np.random.default_rng(5)
+    model = build_model("forest", SPEC)
+    X, y = separable_batch(rng)
+    w = jnp.ones(X.shape[0], jnp.float32)
+    p1 = model.fit(jax.random.key(7), X, y, w)
+    p2 = model.fit(jax.random.key(7), X, y, w)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    p3 = model.fit(jax.random.key(8), X, y, w)
+    assert not np.array_equal(np.asarray(p1.proj), np.asarray(p3.proj))
+    err = float((model.predict(p3, X) != y).mean())
+    assert err < 0.05
+
+
+def test_forest_rejects_bad_params():
+    from distributed_drift_detection_tpu.models.classifiers import make_forest
+
+    with pytest.raises(ValueError, match="forest_trees"):
+        make_forest(SPEC, trees=0)
+    with pytest.raises(ValueError, match="forest_depth"):
+        make_forest(SPEC, depth=0)
+    with pytest.raises(ValueError, match="forest_depth"):
+        make_forest(SPEC, depth=17)
