@@ -1,0 +1,28 @@
+"""Shared one-screen report for the zoo examples (detector_zoo / model_zoo).
+
+Runs the same base config once per variant and prints boundary-attributed
+quality side by side — detections decomposed into first hits vs spurious
+extra fires, with recall and hit-based delay (``metrics.attribution_metrics``).
+"""
+
+from distributed_drift_detection_tpu import run
+from distributed_drift_detection_tpu.config import replace
+from distributed_drift_detection_tpu.metrics import attribution_metrics
+
+
+def zoo_report(base, field: str, names) -> None:
+    """Print one attribution row per variant: ``replace(base, field=name)``."""
+    print(f"{field:<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
+          f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
+    for name in names:
+        res = run(replace(base, **{field: name}))
+        m = res.metrics
+        a = attribution_metrics(
+            res.flags.change_global,
+            res.stream.dist_between_changes,
+            res.stream.num_rows,
+        )
+        fh = f"{a.mean_first_hit_delay_rows:.1f}" if a.hits else "-"
+        print(f"{name:<10} {m.num_detections:>10} {a.hits:>6} "
+              f"{a.spurious:>9} {a.recall:>7.3f} {fh:>16} "
+              f"{res.total_time:>15.3f}")

@@ -18,8 +18,9 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
 
-from distributed_drift_detection_tpu import RunConfig, run
-from distributed_drift_detection_tpu.config import replace
+from _zoo_report import zoo_report
+
+from distributed_drift_detection_tpu import RunConfig
 
 
 def main():
@@ -34,22 +35,11 @@ def main():
         # stream's planted-drift geometry by default — PHParams.threshold = 0
         # → config.auto_ph_threshold; pass PHParams(threshold=...) to pin it.
     )
-    from distributed_drift_detection_tpu.metrics import attribution_metrics
-
-    print(f"{'detector':<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
-          f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
-    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"):
-        res = run(replace(base, detector=name))
-        m = res.metrics
-        a = attribution_metrics(
-            res.flags.change_global,
-            res.stream.dist_between_changes,
-            res.stream.num_rows,
-        )
-        fh = f"{a.mean_first_hit_delay_rows:.1f}" if a.hits else "-"
-        print(f"{name:<10} {m.num_detections:>10} {a.hits:>6} "
-              f"{a.spurious:>9} {a.recall:>7.3f} {fh:>16} "
-              f"{res.total_time:>15.3f}")
+    zoo_report(
+        base,
+        "detector",
+        ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"),
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,41 @@
+"""Compare the model families on one planted-drift stream.
+
+The reference fits one model — sklearn's RandomForest on every microbatch
+(``DDM_Process.py:96-105``); this framework ships six on-device pure-pytree
+families (majority / centroid / gnb / linear / mlp / forest —
+``models/classifiers.py``) plus the host-callback ``rf`` parity path. This
+example runs each on-device family on the same stream/detector/seed and
+reports boundary-attributed quality side by side — detections decomposed
+into first hits vs spurious extra fires, with recall and hit-based delay
+(``metrics.attribution_metrics``). The full acceptance methodology (the
+"≤ 1-batch change vs rf" criterion, both benchmark geometries) lives in
+``harness/parity.py``; this is its one-screen interactive cousin.
+
+    python examples/model_zoo.py [dataset.csv] [mult] [partitions]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
+
+from _zoo_report import zoo_report
+
+from distributed_drift_detection_tpu import RunConfig
+
+
+def main():
+    base = RunConfig(
+        dataset=sys.argv[1] if len(sys.argv) > 1 else "synth:rialto,seed=0",
+        mult_data=float(sys.argv[2]) if len(sys.argv) > 2 else 2,
+        partitions=int(sys.argv[3]) if len(sys.argv) > 3 else 8,
+        per_batch=50,
+        results_csv="",
+    )
+    zoo_report(
+        base, "model", ("majority", "centroid", "gnb", "linear", "mlp", "forest")
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,40 @@ def test_chunked_equals_oneshot():
     assert np.all(got.change_global[:, w:] == -1)
 
 
+@pytest.mark.parametrize("detector", ["kswin", "hddm_w", "adwin"])
+def test_chunked_zoo_equals_oneshot(detector):
+    """The detector seam holds on the streaming surface too: chunked flags
+    with a zoo kernel == the one-shot engine's, state threaded exactly
+    across chunk boundaries (the windowed/buffered members — kswin's ring
+    buffer, adwin's pending chunk + histogram — are the interesting
+    carries; DDM is covered by test_chunked_equals_oneshot)."""
+    from distributed_drift_detection_tpu.ops import make_detector
+
+    stream = make_stream()
+    p, b = 4, 40
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = make_majority(spec)
+    kern = make_detector(detector)
+
+    oneshot = jax.jit(
+        jax.vmap(make_partition_runner(model, REF, shuffle=True, detector=kern))
+    )
+    batches = stripe_partitions(stream, p, b)
+    keys = jax.random.split(jax.random.key(0), p)
+    ref_flags = oneshot(jax.tree.map(jnp.asarray, batches), keys)
+
+    det = ChunkedDetector(
+        model, REF, partitions=p, shuffle=True, seed=0, detector=kern
+    )
+    chunks = chunk_stream_arrays(stream.X, stream.y, p, b, chunk_batches=5)
+    got = det.run(chunks)
+
+    ref_cg = np.asarray(ref_flags.change_global)
+    w = ref_cg.shape[1]
+    np.testing.assert_array_equal(got.change_global[:, :w], ref_cg)
+    assert np.all(got.change_global[:, w:] == -1)
+
+
 @pytest.mark.slow
 def test_generator_chunks_sea():
     """1-shot SEA soak slice through the generator feeder: drift found in
