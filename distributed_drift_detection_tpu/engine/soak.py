@@ -214,10 +214,11 @@ def make_soak_runner(
 
     When it helps: small per-step workloads (small ``per_batch`` × few
     partitions), where the scan is iteration-latency-bound — the same regime
-    the one-shot window engine accelerates ~W×. At the BASELINE.json soak
-    geometry (64 partitions × 1000-row batches ≈ 64 k rows *per step*) each
-    sequential step is already chunky and speculation only adds window
-    slicing + drift-replay overhead: measured on one TPU chip at 1e8 rows,
+    the one-shot window engine accelerates ~W×. At the benchmark soak
+    geometries (the former 64 × 1000 ≈ 64 k rows/step, and the r04 sweep
+    optimum 128 × 2000 ≈ 256 k — bench.py ``_soak_stats``) each sequential
+    step is already chunky and speculation only adds window slicing +
+    drift-replay overhead: measured on one TPU chip at 1e8 rows,
     ``window=64`` runs ~0.6× the sequential engine's throughput. The
     benchmark therefore keeps ``window=1`` for the soak.
     """
