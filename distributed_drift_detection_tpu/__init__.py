@@ -16,6 +16,7 @@ from .config import (
     HDDMWParams,
     KSWINParams,
     PHParams,
+    STEPDParams,
     RunConfig,
     replace,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "HDDMWParams",
     "KSWINParams",
     "PHParams",
+    "STEPDParams",
     "RunConfig",
     "replace",
     "DDMState",

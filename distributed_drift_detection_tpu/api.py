@@ -103,6 +103,7 @@ def _cached_runner(
                 hddm_w=cfg.hddm_w,
                 adwin=cfg.adwin,
                 kswin=cfg.kswin,
+                stepd=cfg.stepd,
             ),
             rotations=cfg.window_rotations,
         )
@@ -116,7 +117,7 @@ def _cached_runner(
         cfg.per_batch, cfg.partitions, spec, cfg.ddm,
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
         cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w, cfg.adwin,
-        cfg.kswin, cfg.window_rotations,
+        cfg.kswin, cfg.stepd, cfg.window_rotations,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)

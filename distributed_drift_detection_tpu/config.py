@@ -187,10 +187,32 @@ class KSWINParams(NamedTuple):
     stat_size: int = 30
 
 
+class STEPDParams(NamedTuple):
+    """STEPD hyper-parameters (detector='stepd', ops/detectors.py; Nishida
+    & Yamauchi 2007 defaults).
+
+    *Statistical Test of Equal Proportions*: the error rate of the most
+    recent ``window_size`` elements against the overall rate since the
+    last reset, via the two-proportion z-test with pooled variance and
+    continuity correction. Change fires when the test rejects at
+    ``alpha_drift`` with the recent rate *higher* (the direction the
+    engines' rotate-on-drift loop consumes); ``alpha_warning`` gates the
+    reported-only warning zone the same way (the paper's two-level
+    scheme — like DDM's, and unlike ADWIN/KSWIN, STEPD has a real warning
+    level). Tested once at least ``2·window_size`` elements have been
+    absorbed."""
+
+    alpha_drift: float = 0.003
+    alpha_warning: float = 0.05
+    window_size: int = 30
+
+
 # Valid RunConfig.detector values (kernels in ops/detectors.py +
 # ops/adwin.py). Lives here, not in ops/, so jax-free consumers (the grid
 # harness CLI) can validate without initialising a backend.
-DETECTOR_NAMES = ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin")
+DETECTOR_NAMES = (
+    "ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -219,11 +241,11 @@ class RunConfig:
     # 'ddm' (the reference's statistic) | 'ph' (Page–Hinkley) | 'eddm' |
     # 'hddm' (HDDM-A, Hoeffding-bound) | 'hddm_w' (HDDM-W, its EWMA
     # companion) | 'adwin' (adaptive windowing; the zoo's only
-    # scan-of-steps kernel — see ops/adwin.py) | 'kswin' (sliding-window
-    # KS test) — the detector zoo, ops/detectors.py. Non-DDM detectors
-    # are a framework extension: the reference only ships DDM, so
-    # cross-reference parity claims (delay tables, oracle goldens) hold
-    # for detector='ddm'.
+    # scan-based kernel — see ops/adwin.py) | 'kswin' (sliding-window
+    # KS test) | 'stepd' (two-proportion test, recent vs overall) — the
+    # detector zoo, ops/detectors.py. Non-DDM detectors are a framework
+    # extension: the reference only ships DDM, so cross-reference parity
+    # claims (delay tables, oracle goldens) hold for detector='ddm'.
     detector: str = "ddm"
     ddm: DDMParams = DDMParams()
     ph: PHParams = PHParams()
@@ -232,6 +254,7 @@ class RunConfig:
     hddm_w: HDDMWParams = HDDMWParams()
     adwin: ADWINParams = ADWINParams()
     kswin: KSWINParams = KSWINParams()
+    stepd: STEPDParams = STEPDParams()
     # Fallback retrain: force rotate+reset+retrain (without recording a DDM
     # change) when a batch's error rate exceeds this threshold. Cures DDM's
     # structural blindspot — a detector reset immediately before a ~100%-error

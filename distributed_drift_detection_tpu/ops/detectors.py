@@ -3,11 +3,12 @@
 The reference is a single-detector artifact — its only statistic is
 skmultiflow's ``DDM`` (``DDM_Process.py:133,139``; rebuilt TPU-native in
 ``ops.ddm``). A drift-detection *framework* owes its users the standard
-alternatives, so this module adds five classic error-stream detectors (a
-sixth, adaptive windowing, lives in ``ops.adwin`` — structurally a
+alternatives, so this module adds six classic error-stream detectors (a
+seventh, adaptive windowing, lives in ``ops.adwin`` — structurally a
 different beast) and a uniform :class:`DetectorKernel` seam the engines
 consume — together the registry covers every detector in skmultiflow's
-``drift_detection`` module (DDM, EDDM, HDDM-A/W, PH, ADWIN, KSWIN):
+``drift_detection`` module (DDM, EDDM, HDDM-A/W, PH, ADWIN, KSWIN) plus
+STEPD (Nishida & Yamauchi 2007):
 
 * **Page–Hinkley** (:func:`ph_batch`) — the clamped CUSUM test (Page 1954;
   the streaming form popularised by Gama et al.'s drift surveys): per error
@@ -83,7 +84,15 @@ consume — together the registry covers every detector in skmultiflow's
   closed-form critical value — see :func:`kswin_step` and the two
   documented deviations in :class:`config.KSWINParams`.
 
-All five are implemented exactly like ``ops.ddm_batch``: the whole microbatch
+* **STEPD** (:func:`stepd_batch`) — *Statistical Test of Equal
+  Proportions* (Nishida & Yamauchi 2007): the error rate of the most
+  recent ``window_size`` elements against the overall rate since reset,
+  via the pooled two-proportion z-test with continuity correction —
+  drift/warning at its classic two significance levels (the one windowed
+  member with a real warning zone). Shares KSWIN's ring-buffer state and
+  scan-free skeleton.
+
+All six are implemented exactly like ``ops.ddm_batch``: the whole microbatch
 (or flattened speculative window) in O(B) vectorised primitives — prefix
 sums for the running statistics and an ``associative_scan`` for the
 sequential part. For Page–Hinkley the recurrence ``m → max(0, α·m + c)`` is
@@ -130,6 +139,7 @@ from ..config import (
     HDDMWParams,
     KSWINParams,
     PHParams,
+    STEPDParams,
 )
 from .ddm import (
     DDMBatchResult,
@@ -930,6 +940,36 @@ def kswin_step(
     return KSWINState(t, buf), (jnp.bool_(False), change)
 
 
+
+def _ring_compact(buf: jax.Array, errs: jax.Array, valid: jax.Array):
+    """Shared skeleton of the ring-buffer detectors (KSWIN, STEPD): compact
+    the valid elements into consecutive slots (invalid → drop bin), prepend
+    the carried right-aligned window, and return everything a windowed
+    statistic needs —
+
+    ``full``  [w+N]: carried buffer ++ compacted batch,
+    ``ps``    [w+N+1]: its zero-led prefix sums (``ps[k] = sum(full[:k])``),
+    ``j``     [N]: each position's compaction index (clipped ``vcnt−1``),
+    ``vcnt``  [N]: running valid count, ``nv`` its total,
+    ``end_buf`` [w]: the next carried window (last w stream elements).
+
+    The w-offset convention: the valid element with compaction index ``j``
+    sits at ``full[w + j]``, so a window of the last ``k`` elements ending
+    at it sums to ``ps[w+j+1] − ps[w+j+1−k]``."""
+    n_el = errs.shape[0]
+    w = buf.shape[0]
+    vcnt = jnp.cumsum(valid.astype(jnp.int32))
+    nv = vcnt[-1]
+    slot = jnp.where(valid, vcnt - 1, n_el)
+    ev = errs.astype(jnp.float32) * valid
+    compact = jnp.zeros((n_el + 1,), jnp.float32).at[slot].set(ev)[:n_el]
+    full = jnp.concatenate([buf, compact])
+    ps = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(full)])
+    j = jnp.clip(vcnt - 1, 0, n_el - 1)
+    end_buf = lax.dynamic_slice_in_dim(full, nv, w)
+    return full, ps, j, vcnt, nv, end_buf
+
+
 def _kswin_masks(
     state: KSWINState, errs: jax.Array, valid: jax.Array, params: KSWINParams
 ):
@@ -942,26 +982,9 @@ def _kswin_masks(
     _validate_kswin(params)
     w, r = int(params.window_size), int(params.stat_size)
     m = w - r
-    n_el = errs.shape[0]
 
-    # Compact valid elements into consecutive slots (invalid → drop bin).
-    vcnt = jnp.cumsum(valid.astype(jnp.int32))
-    nv = vcnt[-1]
-    slot = jnp.where(valid, vcnt - 1, n_el)
-    compact = (
-        jnp.zeros((n_el + 1,), jnp.float32)
-        .at[slot]
-        .set(errs.astype(jnp.float32) * valid)[:n_el]
-    )
-
-    full = jnp.concatenate([state.buf, compact])  # [w + N]
-    ps = jnp.concatenate(
-        [jnp.zeros((1,), jnp.float32), jnp.cumsum(full)]
-    )  # ps[k] = sum(full[:k])
-
-    # Valid element j-th in compaction order sits at full-index w + j; its
-    # window is full[(j+1) .. (w+j)] — recent r, then the older m.
-    j = jnp.clip(vcnt - 1, 0, n_el - 1)
+    _full, ps, j, vcnt, nv, end_buf = _ring_compact(state.buf, errs, valid)
+    # Window of element j: full[(j+1) .. (w+j)] — recent r, then older m.
     hi = ps[w + j + 1]
     mid = ps[w + j + 1 - r]
     lo = ps[j + 1]
@@ -975,9 +998,7 @@ def _kswin_masks(
     )
     warning = jnp.zeros_like(change)
 
-    end_state = KSWINState(
-        state.t + nv, lax.dynamic_slice_in_dim(full, nv, w)
-    )
+    end_state = KSWINState(state.t + nv, end_buf)
     return end_state, warning, change
 
 
@@ -1007,6 +1028,161 @@ def kswin_window(
 
 
 # --------------------------------------------------------------------------
+# STEPD
+# --------------------------------------------------------------------------
+
+
+class STEPDState(NamedTuple):
+    """Carried STEPD state (fixed shapes; vmap adds axes).
+
+    The same right-aligned ring buffer as :class:`KSWINState` (newest at
+    index w−1; slots left of ``w − t`` are unreachable zero-padding) plus
+    the since-reset error total — the "overall" side of the test."""
+
+    t: jax.Array  # i32: elements absorbed since reset
+    total: jax.Array  # f32: errors since reset
+    buf: jax.Array  # f32 [window_size]: last w elements, right-aligned
+
+
+def stepd_init(params: STEPDParams = STEPDParams()) -> STEPDState:
+    return STEPDState(
+        jnp.int32(0),
+        jnp.float32(0.0),
+        jnp.zeros((params.window_size,), jnp.float32),
+    )
+
+
+def _validate_stepd(params: STEPDParams) -> None:
+    """Reject out-of-range concrete params at every public kernel entry
+    (the ``_validate_kswin`` pattern — array-sizing knobs, no traced
+    path)."""
+    for knob in ("alpha_drift", "alpha_warning"):
+        if not 0.0 < float(getattr(params, knob)) < 1.0:
+            raise ValueError(
+                f"STEPDParams.{knob} must be in (0, 1), got "
+                f"{getattr(params, knob)}"
+            )
+    if int(params.window_size) < 2:
+        raise ValueError(
+            f"STEPDParams.window_size must be >= 2, got {params.window_size}"
+        )
+
+
+def _z_crit(alpha: float) -> float:
+    """Upper critical value of the standard normal at two-sided level α:
+    z with 2·(1 − Φ(z)) = α. Solved once at trace time by bisection on
+    ``erf`` (no scipy dependency; 80 iterations ≈ double precision)."""
+    import math
+
+    target = 1.0 - alpha / 2.0  # Φ(z) = target
+    lo, hi = 0.0, 10.0
+    for _ in range(80):
+        mid = 0.5 * (lo + hi)
+        if 0.5 * (1.0 + math.erf(mid / math.sqrt(2.0))) < target:
+            lo = mid
+        else:
+            hi = mid
+    return 0.5 * (lo + hi)
+
+
+def _stepd_signal(t, total, recent_sum, params: STEPDParams):
+    """The two-proportion test shared by the scalar step and the batch
+    pass. ``t``/``total`` are since-reset counts, ``recent_sum`` the error
+    sum of the last ``w`` elements; all inputs may be vectors.
+
+    Nishida & Yamauchi 2007: with recent proportion p̂_r over n_r = w and
+    older proportion p̂_o over n_o = t − w, pooled p̂ = total/t, reject
+    when
+
+        |p̂_o − p̂_r| − ½(1/n_o + 1/n_r)
+        ───────────────────────────────── > z_crit(α)
+          sqrt(p̂(1−p̂)(1/n_o + 1/n_r))
+
+    — drift at ``alpha_drift``, warning at ``alpha_warning``, both gated
+    on the recent rate being the *higher* one (error increase; the
+    engines' rotate-on-drift loop consumes no "improvement" signal) and
+    on ``t ≥ 2w`` (both sides populated)."""
+    w = int(params.window_size)
+    n_o = (t - w).astype(jnp.float32)
+    n_of = jnp.maximum(n_o, 1.0)
+    p_r = recent_sum / w
+    p_o = (total - recent_sum) / n_of
+    p_hat = total / jnp.maximum(t, 1).astype(jnp.float32)
+    inv = 1.0 / n_of + 1.0 / w
+    num = jnp.abs(p_o - p_r) - 0.5 * inv
+    den = jnp.sqrt(jnp.maximum(p_hat * (1.0 - p_hat) * inv, 1e-30))
+    z = num / den
+    gate = (t >= 2 * w) & (p_r > p_o)
+    change = gate & (z > jnp.float32(_z_crit(params.alpha_drift)))
+    warning = (
+        gate & ~change & (z > jnp.float32(_z_crit(params.alpha_warning)))
+    )
+    return warning, change
+
+
+def stepd_step(
+    state: STEPDState, err: jax.Array, params: STEPDParams = STEPDParams()
+) -> tuple[STEPDState, tuple[jax.Array, jax.Array]]:
+    """One element (executable spec — see :func:`_stepd_signal`)."""
+    _validate_stepd(params)
+    buf = jnp.roll(state.buf, -1).at[-1].set(err.astype(jnp.float32))
+    t = state.t + 1
+    total = state.total + err.astype(jnp.float32)
+    warning, change = _stepd_signal(t, total, jnp.sum(buf), params)
+    return STEPDState(t, total, buf), (warning, change)
+
+
+def _stepd_masks(
+    state: STEPDState, errs: jax.Array, valid: jax.Array, params: STEPDParams
+):
+    """Flat ``[N]`` pass → ``(end_state, warning[N], change[N])``.
+
+    The same scan-free skeleton as :func:`_kswin_masks`: compact the valid
+    elements, concatenate the carried ring buffer, and every position's
+    recent-window sum is one difference of one prefix-sum vector; the
+    overall totals are an ordinary cumsum."""
+    _validate_stepd(params)
+    w = int(params.window_size)
+
+    _full, ps, j, vcnt, nv, end_buf = _ring_compact(state.buf, errs, valid)
+    ev = errs.astype(jnp.float32) * valid
+    recent = ps[w + j + 1] - ps[j + 1]
+    t_at = state.t + vcnt
+    total_at = state.total + jnp.cumsum(ev)
+    warning, change = _stepd_signal(t_at, total_at, recent, params)
+    warning = warning & valid
+    change = change & valid
+
+    end_state = STEPDState(state.t + nv, state.total + jnp.sum(ev), end_buf)
+    return end_state, warning, change
+
+
+def stepd_batch(
+    state: STEPDState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: STEPDParams = STEPDParams(),
+) -> tuple[STEPDState, DDMBatchResult]:
+    """Vectorised microbatch update (contract of :func:`ops.ddm.ddm_batch`)."""
+    end_state, warning, change = _stepd_masks(state, errs, valid, params)
+    return end_state, summarise_batch(warning, change)
+
+
+def stepd_window(
+    state: STEPDState,
+    errs: jax.Array,
+    valid: jax.Array,
+    params: STEPDParams = STEPDParams(),
+) -> tuple[STEPDState, DDMWindowResult]:
+    """W batches in one flattened pass (contract of :func:`ops.ddm.ddm_window`)."""
+    w, b = errs.shape
+    end_state, warning, change = _stepd_masks(
+        state, errs.reshape(-1), valid.reshape(-1), params
+    )
+    return end_state, summarise_window(warning, change, w, b)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -1020,6 +1196,7 @@ def make_detector(
     hddm_w: HDDMWParams = HDDMWParams(),
     adwin: ADWINParams = ADWINParams(),
     kswin: KSWINParams = KSWINParams(),
+    stepd: STEPDParams = STEPDParams(),
 ) -> DetectorKernel:
     """Build a :class:`DetectorKernel` by config name (``RunConfig.detector``)."""
     if name == "ddm":
@@ -1102,6 +1279,15 @@ def make_detector(
             lambda s, e, v: kswin_batch(s, e, v, kswin),
             lambda s, e, v: kswin_window(s, e, v, kswin),
             kswin,
+        )
+    if name == "stepd":
+        _validate_stepd(stepd)
+        return DetectorKernel(
+            "stepd",
+            lambda: stepd_init(stepd),
+            lambda s, e, v: stepd_batch(s, e, v, stepd),
+            lambda s, e, v: stepd_window(s, e, v, stepd),
+            stepd,
         )
     raise ValueError(
         f"unknown detector {name!r}; expected one of {DETECTOR_NAMES}"

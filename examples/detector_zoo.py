@@ -1,10 +1,11 @@
-"""Compare the seven drift detectors on one planted-drift stream.
+"""Compare the eight drift detectors on one planted-drift stream.
 
 The reference ships a single statistic (skmultiflow's DDM,
 ``DDM_Process.py:133``); this framework adds Page–Hinkley, EDDM, HDDM-A,
-HDDM-W, ADWIN and KSWIN — the full skmultiflow ``drift_detection`` zoo —
+HDDM-W, ADWIN, KSWIN — the full skmultiflow ``drift_detection`` zoo —
+plus STEPD,
 behind the same engine seam (``ops/detectors.py`` + ``ops/adwin.py``).
-This example runs all seven on the same stream/model/seed and reports
+This example runs all eight on the same stream/model/seed and reports
 boundary-attributed quality side by side — detections decomposed into
 first hits vs spurious extra fires, with recall and hit-based delay
 (``metrics.attribution_metrics``) — the quickest way to see how their
@@ -38,7 +39,7 @@ def main():
     zoo_report(
         base,
         "detector",
-        ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"),
+        ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"),
     )
 
 

@@ -54,12 +54,12 @@ def test_chunked_equals_oneshot():
     assert np.all(got.change_global[:, w:] == -1)
 
 
-@pytest.mark.parametrize("detector", ["kswin", "hddm_w", "adwin"])
+@pytest.mark.parametrize("detector", ["kswin", "hddm_w", "adwin", "stepd"])
 def test_chunked_zoo_equals_oneshot(detector):
     """The detector seam holds on the streaming surface too: chunked flags
     with a zoo kernel == the one-shot engine's, state threaded exactly
-    across chunk boundaries (the windowed/buffered members — kswin's ring
-    buffer, adwin's pending chunk + histogram — are the interesting
+    across chunk boundaries (the windowed/buffered members — kswin's/stepd's ring
+    buffers, adwin's pending chunk + histogram — are the interesting
     carries; DDM is covered by test_chunked_equals_oneshot)."""
     from distributed_drift_detection_tpu.ops import make_detector
 

@@ -20,6 +20,7 @@ from distributed_drift_detection_tpu.config import (
     ADWINParams,
     EDDMParams,
     KSWINParams,
+    STEPDParams,
     HDDMParams,
     HDDMWParams,
     PHParams,
@@ -53,6 +54,10 @@ from distributed_drift_detection_tpu.ops.detectors import (
     ph_init,
     ph_step,
     ph_window,
+    stepd_batch,
+    stepd_init,
+    stepd_step,
+    stepd_window,
 )
 
 PH = PHParams(min_num_instances=5, delta=0.005, threshold=3.0)
@@ -375,6 +380,56 @@ class OracleKSWIN:
         self.in_change = abs(recent - old) > self.crit
 
 
+class OracleSTEPD:
+    """Independent per-element STEPD (Nishida & Yamauchi 2007, as specced
+    in ops/detectors.py): recent window_size elements vs the overall rate
+    since reset, pooled two-proportion z-test with continuity correction,
+    drift/warning at the two significance levels, gated on error increase
+    and t >= 2*window_size."""
+
+    # Independently sourced two-sided normal critical values (NOT computed
+    # with the kernel's _z_crit — a convention bug there must not propagate
+    # here): scipy.stats.norm.ppf(1 - alpha/2) reference values.
+    Z_TABLE = {0.003: 2.9677379253417833, 0.05: 1.959963984540054}
+
+    def __init__(self, p: STEPDParams):
+        self.p = p
+        self.t = 0
+        self.total = 0.0
+        self.buf = []  # last window_size elements, oldest first
+        self.z_d = self.Z_TABLE[p.alpha_drift]
+        self.z_w = self.Z_TABLE[p.alpha_warning]
+        self.in_warning = False
+        self.in_change = False
+
+    def add_element(self, x: float) -> None:
+        import math
+
+        w = self.p.window_size
+        self.t += 1
+        self.total += x
+        self.buf.append(x)
+        if len(self.buf) > w:
+            self.buf.pop(0)
+        self.in_change = self.in_warning = False
+        if self.t < 2 * w:
+            return
+        n_o = self.t - w
+        recent = sum(self.buf)
+        p_r = recent / w
+        p_o = (self.total - recent) / n_o
+        if not p_r > p_o:
+            return
+        p_hat = self.total / self.t
+        inv = 1.0 / n_o + 1.0 / w
+        den = math.sqrt(max(p_hat * (1.0 - p_hat) * inv, 1e-30))
+        z = (abs(p_o - p_r) - 0.5 * inv) / den
+        if z > self.z_d:
+            self.in_change = True
+        elif z > self.z_w:
+            self.in_warning = True
+
+
 def oracle_flags(oracle_cls, params, errs, valid):
     o = oracle_cls(params)
     warn = np.zeros(len(errs), bool)
@@ -414,6 +469,7 @@ AD = ADWINParams(max_levels=12)
 # Small enough that the 96-element fuzz streams and 256-element CASES
 # streams exercise full-window testing, not just warm-up.
 KW = KSWINParams(window_size=40, stat_size=10)
+SD = STEPDParams(window_size=20)  # 2w = 40 << the test streams
 
 CASES = [
     ("ph", OraclePH, PH, ph_init, ph_step, ph_batch, ph_window),
@@ -429,6 +485,8 @@ CASES = [
      lambda: adwin_init(AD), adwin_step, adwin_batch, adwin_window),
     ("kswin", OracleKSWIN, KW,
      lambda: kswin_init(KW), kswin_step, kswin_batch, kswin_window),
+    ("stepd", OracleSTEPD, SD,
+     lambda: stepd_init(SD), stepd_step, stepd_batch, stepd_window),
 ]
 
 
@@ -449,7 +507,12 @@ def test_batch_matches_oracle(name, ocls, params, init, step, batch, window, see
     assert int(res.first_change) == fc
     assert int(res.first_warning) == fw
     if fc < 0:  # end state only meaningful when no change fired
-        if name == "kswin":
+        if name == "stepd":
+            assert int(state.t) == o.t
+            np.testing.assert_allclose(float(state.total), o.total, rtol=1e-6)
+            got = np.asarray(state.buf)[-len(o.buf):] if o.buf else []
+            np.testing.assert_allclose(got, o.buf, rtol=1e-6)
+        elif name == "kswin":
             assert int(state.t) == o.t
             got = np.asarray(state.buf)[-len(o.buf):] if o.buf else []
             np.testing.assert_allclose(got, o.buf, rtol=1e-6)
@@ -543,7 +606,7 @@ def test_vmap_over_independent_lanes():
     P, B = 2, 128
     errs = (rng.random((P, B)) < 0.3).astype(np.float32)
     valid = np.ones((P, B), bool)
-    for name in ("ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"):
+    for name in ("ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"):
         det = make_detector(name, ph=PH, eddm=ED)
         states = jax.vmap(lambda _: det.init())(jnp.arange(P))
         _, res = jax.vmap(det.batch)(states, jnp.asarray(errs), jnp.asarray(valid))
@@ -635,6 +698,17 @@ def test_adwin_rejects_bad_params():
     v = jnp.ones(8, bool)
     with pytest.raises(ValueError, match="max_buckets"):
         adwin_batch(adwin_init(), e, v, ADWINParams(max_buckets=1))
+
+
+def test_stepd_rejects_bad_params():
+    with pytest.raises(ValueError, match="alpha_drift"):
+        make_detector("stepd", stepd=STEPDParams(alpha_drift=0.0))
+    with pytest.raises(ValueError, match="window_size"):
+        make_detector("stepd", stepd=STEPDParams(window_size=1))
+    e = jnp.zeros(8, jnp.float32)
+    v = jnp.ones(8, bool)
+    with pytest.raises(ValueError, match="alpha_warning"):
+        stepd_batch(stepd_init(), e, v, STEPDParams(alpha_warning=1.0))
 
 
 def test_kswin_rejects_bad_params():
@@ -802,7 +876,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
     """Non-DDM detectors fire near the planted concept boundaries end to end,
@@ -824,7 +898,7 @@ def _sequential_flags(detector):
 
 
 @pytest.mark.parametrize("rotations", [1, 3])
-@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"])
+@pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"])
 def test_window_engine_matches_sequential(detector, rotations):
     """Window engine == sequential for the zoo members too, at both
     speculation depths (the level loop resets *any* DetectorKernel's state

@@ -46,7 +46,7 @@ def test_detector_zoo_example(tmp_path):
     # tiny geometry (mult=1, 4 partitions): the assertion is that every zoo
     # member runs and reports, not detection quality — keep the fast tier fast
     out = run_example(tmp_path, "detector_zoo.py", "synth:rialto,seed=0", 1, 4)
-    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin"):
+    for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"):
         # row-anchored: "hddm_w"/"eddm" contain "hddm"/"ddm" as substrings,
         # so a bare `name in out` could never fail for the shorter names
         assert f"\n{name} " in out, f"detector {name} row missing:\n{out}"

@@ -168,7 +168,7 @@ ZB = 48  # fixed zoo-batch length → one compile per case
 @settings(max_examples=15, deadline=None)
 @given(
     data=st.data(),
-    name=st.sampled_from(["ph", "eddm", "eddm_exact", "hddm", "hddm_w", "adwin", "kswin"]),
+    name=st.sampled_from(["ph", "eddm", "eddm_exact", "hddm", "hddm_w", "adwin", "kswin", "stepd"]),
 )
 def test_zoo_batch_matches_oracle_on_fuzzed_streams(data, name):
     """Detector-zoo batch kernels == their per-element oracles under fuzzed
