@@ -10,9 +10,16 @@ The ``backend=`` seam mirrors the north-star plugin boundary:
 
 * ``'jax'`` — the TPU-native path: jit + vmap over partitions, sharded over a
   ``Mesh`` when more than one device is visible.
-* ``'spark'`` — interface-identical stub for the reference's execution model;
-  always raises ``NotImplementedError`` (with install guidance when PySpark
-  is absent) — the Spark path is deliberately not reimplemented.
+* ``'spark'`` — **formally retired** (round 5, recorded decision): the
+  reference's execution model (``DDM_Process.py:58-72,216-226``) ran on a
+  Spark standalone cluster; this framework's native path replaces it
+  end-to-end, PySpark is not present in the supported environment, and a
+  Spark *local-mode* reimplementation would exercise none of the cluster
+  semantics that made the seam interesting. Selecting it raises a
+  ``ValueError`` explaining the decision. Flag-level A/B against the
+  reference's execution semantics is served by the pure-NumPy oracle loop
+  (``tests/oracle.py`` + golden tests) and the delay-parity harness
+  (``harness/parity.py``) instead.
 
 The timed span matches the reference's ``Final Time``
 (``DDM_Process.py:224→:260``): device upload + compiled loop + flag
@@ -35,6 +42,7 @@ from .config import (
     auto_window,
     host_shuffle_seed,
     replace,
+    resolve_retrain_threshold,
 )
 from .engine.loop import FlagRows
 from .io.stream import (
@@ -147,6 +155,10 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # window == 0 → auto-size from the stream's planted drift spacing;
     # window_rotations == 0 → auto depth (needs the resolved window first);
     # ph.threshold == 0 → auto-tune λ from the same geometry.
+    # retrain_error_threshold auto (RETRAIN_AUTO): per-model-family guard
+    # resolution — config.resolve_retrain_threshold. Resolved first so the
+    # runner cache keys on what actually runs.
+    cfg = replace(cfg, retrain_error_threshold=resolve_retrain_threshold(cfg))
     cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
     cfg = replace(
         cfg,
@@ -203,9 +215,20 @@ class RunResult(NamedTuple):
 
 def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
     if cfg.backend == "spark":
-        return _run_spark(cfg)
+        # Recorded decision (round 5; PARITY.md C3, README "Spark seam"):
+        # the seam is retired, not stubbed — see the module docstring.
+        raise ValueError(
+            "backend='spark' is retired: the reference's Spark execution "
+            "model (DDM_Process.py:58-72) is fully replaced by the native "
+            "backend='jax' path (same RunConfig, same results schema), "
+            "PySpark is not part of the supported environment, and a "
+            "local-mode reimplementation would exercise none of the "
+            "cluster semantics. For flag-level A/B against the reference's "
+            "loop semantics use the NumPy oracle (tests/oracle.py) or the "
+            "delay-parity harness (harness/parity.py)."
+        )
     if cfg.backend != "jax":
-        raise ValueError(f"unknown backend {cfg.backend!r}; expected 'jax' or 'spark'")
+        raise ValueError(f"unknown backend {cfg.backend!r}; expected 'jax'")
     return _run_jax(cfg, stream)
 
 
@@ -277,17 +300,3 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     return RunResult(flags, vote, m, total_time, timer.as_dict(), stream, cfg)
 
 
-def _run_spark(cfg: RunConfig):
-    try:
-        import pyspark  # noqa: F401
-    except ImportError as e:
-        raise NotImplementedError(
-            "backend='spark' preserves the reference's execution-model seam "
-            "(SURVEY.md §7 layer 6) but PySpark is not installed in this "
-            "environment. Use backend='jax' — it accepts the same RunConfig "
-            "and produces the same results schema."
-        ) from e
-    raise NotImplementedError(
-        "The Spark execution path is intentionally not reimplemented; "
-        "this framework's native path is backend='jax'."
-    )

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..config import DDMParams
+from ..config import AUTO_RETRAIN_THRESHOLD, RETRAIN_AUTO, DDMParams
 from ..models.base import Model
 from .loop import (
     Batches,
@@ -54,7 +54,7 @@ class ChunkedDetector:
         *,
         partitions: int,
         shuffle: bool = False,
-        retrain_error_threshold: float | None = None,
+        retrain_error_threshold: float | None = RETRAIN_AUTO,
         seed: int = 0,
         window: int = 1,
         mesh=None,
@@ -77,6 +77,18 @@ class ChunkedDetector:
         # engine's speculation depth (make_window_span) — same exactness
         # contract, fewer sequential steps per drift; requires window > 1
         # (rejected otherwise, matching parallel.mesh.make_mesh_runner).
+        # RETRAIN_AUTO (any negative value): same per-family saturation-guard
+        # resolution as api.prepare, driven by the model-spec flag
+        # (Model.saturation_guard) since this engine takes a Model, not a
+        # RunConfig — config.resolve_retrain_threshold's contract.
+        if (
+            retrain_error_threshold is not None
+            and retrain_error_threshold < 0.0
+        ):
+            retrain_error_threshold = (
+                AUTO_RETRAIN_THRESHOLD if model.saturation_guard else None
+            )
+        self.retrain_error_threshold = retrain_error_threshold
         self.model = model
         self.partitions = partitions
         self._detector = resolve_detector(ddm_params, detector)

@@ -155,6 +155,26 @@ def resolve_detector(ddm_params: DDMParams, detector=None):
     return make_detector("ddm", ddm=ddm_params)
 
 
+def _check_retrain_threshold(thr: float | None) -> None:
+    """Reject a leaked RETRAIN_AUTO sentinel at the engine boundary.
+
+    ``config.RunConfig.retrain_error_threshold`` defaults to −1.0 (auto);
+    it is resolved to a per-family value by ``api.prepare`` /
+    ``ChunkedDetector`` (``config.resolve_retrain_threshold``). The
+    low-level engines take the *resolved* value only — a negative
+    threshold here would silently mean "force a retrain on every nonempty
+    batch" (``err_rate > −1`` is always true), destroying detection
+    behaviour, so it fails loudly instead.
+    """
+    if thr is not None and thr < 0.0:
+        raise ValueError(
+            f"retrain_error_threshold={thr} is negative — the RETRAIN_AUTO "
+            "sentinel must be resolved before reaching an engine "
+            "(config.resolve_retrain_threshold); pass None to disable or a "
+            "non-negative float to pin"
+        )
+
+
 def make_partition_step(
     model: Model,
     ddm_params: DDMParams,
@@ -168,6 +188,7 @@ def make_partition_step(
     ``detector`` (a :class:`..ops.detectors.DetectorKernel`) swaps the drift
     statistic; ``None`` keeps the reference's DDM with ``ddm_params``.
     """
+    _check_retrain_threshold(retrain_error_threshold)
     det = resolve_detector(ddm_params, detector)
 
     def step(carry: LoopCarry, batch) -> tuple[LoopCarry, FlagRows]:

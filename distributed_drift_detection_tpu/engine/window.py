@@ -119,6 +119,9 @@ def make_window_span(
     assert w >= 1
     if r_levels < 1:
         raise ValueError(f"rotations must be >= 1, got {rotations}")
+    from .loop import _check_retrain_threshold
+
+    _check_retrain_threshold(retrain_error_threshold)
     det = resolve_detector(ddm_params, detector)
     # The window statistic runs as XLA primitives (cumsum + associative_scan,
     # ops/ddm.py). A fused Pallas twin was measured and removed in round 2 —

@@ -30,6 +30,7 @@ from ..config import (
     DETECTOR_NAMES,
     RunConfig,
     replace,
+    resolve_retrain_threshold,
 )
 from ..results import read_results
 
@@ -69,11 +70,12 @@ def _config_key(cfg: RunConfig) -> str:
         raise ValueError(
             f"unknown detector {cfg.detector!r}; expected one of {DETECTOR_NAMES}"
         )
-    thr = (
-        f"-r{cfg.retrain_error_threshold}"
-        if cfg.retrain_error_threshold is not None  # 0.0 is an active setting
-        else ""
-    )
+    # Key on the *resolved* guard (RETRAIN_AUTO → per-family value): the key
+    # must name what actually runs, so the auto default keeps non-guarded
+    # families' completed trials valid while retiring guarded families'
+    # pre-guard rows. 0.0 is an active setting; None resolves to no segment.
+    rthr = resolve_retrain_threshold(cfg)
+    thr = f"-r{rthr}" if rthr is not None else ""
     # The execution policy is part of every trial's identity: window and
     # speculation depth change the recorded Final Time for every model (the
     # grid's primary result column) and additionally the flags for
@@ -98,6 +100,8 @@ def _config_key(cfg: RunConfig) -> str:
     if cfg.detector == "ddm":
         d = cfg.ddm
         det = f"ddm{d.min_num_instances}_{d.warning_level}_{d.out_control_level}"
+        if d.noise_floor:  # suffix only when active: pre-floor keys unchanged
+            det += f"f{d.noise_floor}"
     else:
         det = cfg.detector + "_".join(
             str(v) for v in getattr(cfg, cfg.detector)

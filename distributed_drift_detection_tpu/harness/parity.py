@@ -67,7 +67,26 @@ FIELDS = [
     "dataset",
 ]
 
-DEFAULT_MODELS = ("rf", "centroid", "gnb", "mlp", "linear", "forest")
+# Model specs: a bare family name, or ``family@variant`` selecting a shipped
+# preset (the ``model`` CSV column records the full spec). Variants:
+#   @robust — detector preset ``config.DDM_ROBUST`` (band-width noise floor
+#   at the reference's REGRESSION_THRESH constant): the shipped config for
+#   residual-error families — ``linear``'s documented over-firing fix
+#   (VERDICT r4 #5; measured in the committed artifact's linear@robust rows).
+DEFAULT_MODELS = (
+    "rf", "centroid", "gnb", "mlp", "linear", "forest", "linear@robust",
+)
+
+# The acceptance gate (``report(required=...)``) covers every shipped
+# on-device family (VERDICT r4 #1 — the flagship-only gate let silent
+# failures ship). Documented, tested opt-outs:
+#   * ``linear`` at the reference's raw 3/0.5/1.5 sensitivity — measured
+#     over-firing on rialto-like regimes (PARITY.md); its gated
+#     configuration is ``linear@robust`` (the shipped preset above).
+#   * ``majority`` — golden-oracle family, not part of the parity sweep
+#     (bit-exact tests in tests/test_engine.py are its acceptance).
+#   * ``rf`` — the baseline itself.
+REQUIRED_MODELS = ("centroid", "gnb", "mlp", "forest", "linear@robust")
 
 # The two benchmark geometries of the committed artifact (VERDICT r3 #3/#4:
 # parity must hold on the reference's *primary published dataset*, not only
@@ -104,21 +123,30 @@ def measure_delay_parity(
     the model family alone — the comparison the criterion needs.
     """
     from ..api import run
-    from ..config import RunConfig
+    from ..config import DDM_ROBUST, RunConfig
     from ..metrics import attribution_metrics
 
     rows = []
     for model in models:
+        family, _, variant = model.partition("@")
+        extra = {}
+        if variant == "robust":
+            extra["ddm"] = DDM_ROBUST
+        elif variant:
+            raise ValueError(
+                f"unknown model variant {model!r}; known: @robust"
+            )
         for seed in seeds:
             cfg = RunConfig(
                 dataset=dataset,
                 mult_data=mult_data,
                 partitions=partitions,
                 per_batch=per_batch,
-                model=model,
+                model=family,
                 seed=seed,
                 rf_estimators=rf_estimators,
                 results_csv="",
+                **extra,
             )
             res = run(cfg)
             m = res.metrics
@@ -289,15 +317,15 @@ def write_csv(rows: list[dict], path: str) -> None:
 
 
 def report(
-    rows: list[dict], progress=print, required: tuple = ("centroid",)
+    rows: list[dict], progress=print, required: tuple = REQUIRED_MODELS
 ) -> bool:
     """Per-geometry summary table + both acceptance criteria; returns True
     when every ``required`` model passes both axes in every geometry that
-    has the rf baseline. Only the flagship gates the verdict by default:
-    the sweep deliberately measures families with *documented* domain
-    failures (linear over-fires on rialto-like regimes; gnb cannot separate
-    the rialto stand-in at all — PARITY.md), and an artifact regeneration
-    that honestly records them must not report failure for doing so."""
+    has the rf baseline. The default gate covers every shipped on-device
+    family (``REQUIRED_MODELS``); the sweep additionally measures the
+    documented opt-outs (bare ``linear`` at the reference's raw
+    sensitivity — its gated form is ``linear@robust``) so the artifact
+    still records them honestly without reporting failure for doing so."""
     all_ok = True
     for key, grp in group_by_geometry(rows).items():
         dataset, mult, partitions, _ = key
@@ -439,10 +467,12 @@ def main(argv=None) -> None:
         write_csv(rows, args.out)
     print(f"\nwrote {args.out} ({len(rows)} rows)")
     # Exit status carries the acceptance verdict (CI/cron don't scrape
-    # stdout for 'FAIL'). The gate is the flagship *when it was swept*: a
-    # deliberate --models subset without centroid is an informational run
-    # and must not exit 1 for omitting it.
-    required = tuple(m for m in ("centroid",) if m in args.models.split(","))
+    # stdout for 'FAIL'). The gate covers the required families *that were
+    # swept*: a deliberate --models subset is an informational run and must
+    # not exit 1 for omitting families.
+    required = tuple(
+        m for m in REQUIRED_MODELS if m in args.models.split(",")
+    )
     raise SystemExit(0 if report(rows, required=required) else 1)
 
 

@@ -37,6 +37,11 @@ class Model(NamedTuple):
     # mesh participants block at the drift-vote all-reduce, aborting the
     # process. Engines reject mesh + host_callback combinations.
     host_callback: bool = False
+    # True for memorizer families that ship with the saturated-error retrain
+    # guard by default (config.GUARDED_MODELS — the RETRAIN_AUTO resolution;
+    # see config.resolve_retrain_threshold for the failure mode and why
+    # ``majority``, also a memorizer, deliberately stays False).
+    saturation_guard: bool = False
 
 
 def require_shardable(model: Model, mesh) -> None:

@@ -184,7 +184,10 @@ def make_gnb(spec: ModelSpec, *, var_smoothing: float = 1e-6) -> Model:
         )
         return jnp.argmax(scores, axis=-1).astype(jnp.int32)
 
-    return Model("gnb", init, fit, predict)
+    # saturation_guard: gnb's batch fit is a memorizer on single-class
+    # concept batches (r04 measured rialto-stand-in failure; the guard is
+    # the measured mitigation — config.GUARDED_MODELS).
+    return Model("gnb", init, fit, predict, saturation_guard=True)
 
 
 # --------------------------------------------------------------------------
@@ -433,7 +436,10 @@ def make_forest(spec: ModelSpec, *, trees: int = 32, depth: int = 3) -> Model:
         # argmax ties resolve to the lowest class (the majority-model rule)
         return jnp.argmax(tally, axis=-1).astype(jnp.int32)
 
-    return Model("forest", init, fit, predict)
+    # saturation_guard: pure leaf memorization across concept boundaries —
+    # the measured memorizer × blindspot failure in this docstring; the
+    # guard is the measured mitigation (config.GUARDED_MODELS).
+    return Model("forest", init, fit, predict, saturation_guard=True)
 
 
 # --------------------------------------------------------------------------

@@ -88,6 +88,31 @@ def ddm_init() -> DDMState:
     )
 
 
+def _band_s(s_min: jax.Array, cnt_f: jax.Array, params: DDMParams):
+    """Effective band-width std: ``max(s_min, Δ / out_control_level)``.
+
+    Δ = ``params.noise_floor`` (``config.DDMParams``): the minimum
+    running-error-rate excursion treated as change. Guards the zero-minima
+    trap — an error-free stretch captures ``s_min = 0``, making the
+    warning/change bands zero-width so one residual error fires a change.
+    With the floor, the change band is ``max(L·s_min, Δ)`` and the warning
+    band scales with it (``(w/L)·Δ``), preserving the reference's band
+    geometry; Δ = 0 is exactly classic DDM (compile-time branch: no extra
+    ops in the reference-exact default). Applied to the band width only;
+    minima tracking is untouched. ``cnt_f`` is unused but kept in the
+    signature so an n-aware floor stays a local change.
+    """
+    nf = params.noise_floor
+    if isinstance(nf, (int, float)) and float(nf) == 0.0:
+        return s_min  # reference-exact default: literally no extra ops
+    # Traced-params path (property tests jit over params): all-array math.
+    # f32 divide, mirrored exactly by the oracle (tests/oracle.py).
+    return jnp.maximum(
+        s_min,
+        jnp.float32(nf) / jnp.float32(params.out_control_level),
+    )
+
+
 def ddm_step(
     state: DDMState, err: jax.Array, params: DDMParams = DDMParams()
 ) -> tuple[DDMState, tuple[jax.Array, jax.Array]]:
@@ -114,8 +139,9 @@ def ddm_step(
     p_min = jnp.where(take, p, state.p_min)
     s_min = jnp.where(take, s, state.s_min)
 
-    change = check & (ps > p_min + params.out_control_level * s_min)
-    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
+    s_band = _band_s(s_min, cnt_f, params)
+    change = check & (ps > p_min + params.out_control_level * s_band)
+    warning = check & ~change & (ps > p_min + params.warning_level * s_band)
 
     new_state = DDMState(cnt, esum, ps_min, p_min, s_min)
     return new_state, (warning, change)
@@ -170,8 +196,9 @@ def _prefix_masks(
     p_min = jnp.where(use_run, run_p, state.p_min)
     s_min = jnp.where(use_run, run_s, state.s_min)
 
-    change = check & (ps > p_min + params.out_control_level * s_min)
-    warning = check & ~change & (ps > p_min + params.warning_level * s_min)
+    s_band = _band_s(s_min, cnt_f, params)
+    change = check & (ps > p_min + params.out_control_level * s_band)
+    warning = check & ~change & (ps > p_min + params.warning_level * s_band)
 
     end_state = DDMState(
         count=cnt[-1],

@@ -38,6 +38,9 @@ class OracleDDM:
     min_num_instances: int = 3
     warning_level: float = 0.5
     out_control_level: float = 1.5
+    # Band-width noise floor Δ (config.DDMParams.noise_floor; 0 = classic
+    # DDM): thresholds use max(s_min, Δ/out_control_level) as the band std.
+    noise_floor: float = 0.0
     incremental: bool = False
     count: int = 0
     err_sum: float = 0.0
@@ -65,9 +68,16 @@ class OracleDDM:
             return
         if ps <= self.ps_min:
             self.ps_min, self.p_min, self.s_min = ps, p, s
-        if ps > float(F32(self.p_min) + F32(self.out_control_level) * F32(self.s_min)):
+        s_band = F32(self.s_min)
+        if self.noise_floor:
+            # f32 divide of the f32-cast operands — the kernel's exact
+            # expression (ops/ddm._band_s).
+            s_band = max(
+                s_band, F32(self.noise_floor) / F32(self.out_control_level)
+            )
+        if ps > float(F32(self.p_min) + F32(self.out_control_level) * s_band):
             self.in_change = True
-        elif ps > float(F32(self.p_min) + F32(self.warning_level) * F32(self.s_min)):
+        elif ps > float(F32(self.p_min) + F32(self.warning_level) * s_band):
             self.in_warning = True
 
 

@@ -73,9 +73,16 @@ def test_timings_present(tmp_path):
         assert phase in res.timings
 
 
-def test_spark_backend_stub(tmp_path):
-    with pytest.raises(NotImplementedError, match="backend='jax'"):
+def test_spark_backend_retired(tmp_path):
+    # The seam is a recorded retirement, not a surprise NotImplementedError:
+    # the error names the decision and the A/B alternatives (api.run).
+    with pytest.raises(ValueError, match="retired.*backend='jax'"):
         run(base_cfg(tmp_path, backend="spark"))
+
+
+def test_unknown_backend_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown backend"):
+        run(base_cfg(tmp_path, backend="dask"))
 
 
 def test_linear_model_end_to_end(tmp_path):

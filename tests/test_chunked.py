@@ -256,3 +256,26 @@ def test_host_callback_model_rejected_on_mesh():
     rf = make_rf(ModelSpec(4, 3), batch_size=10)
     with pytest.raises(ValueError, match="host callback"):
         ChunkedDetector(rf, REF, partitions=8, mesh=make_mesh(8))
+
+
+def test_chunked_auto_guard_resolution():
+    """ChunkedDetector's RETRAIN_AUTO default resolves via the model-spec
+    flag (Model.saturation_guard), mirroring api.prepare's config path."""
+    from distributed_drift_detection_tpu.config import AUTO_RETRAIN_THRESHOLD
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    spec = ModelSpec(num_features=3, num_classes=2)
+    gnb = ChunkedDetector(build_model("gnb", spec), partitions=2)
+    assert gnb.retrain_error_threshold == AUTO_RETRAIN_THRESHOLD
+    maj = ChunkedDetector(build_model("majority", spec), partitions=2)
+    assert maj.retrain_error_threshold is None  # golden family: unguarded
+    off = ChunkedDetector(
+        build_model("gnb", spec), partitions=2, retrain_error_threshold=None
+    )
+    assert off.retrain_error_threshold is None
+    pinned = ChunkedDetector(
+        build_model("centroid", spec), partitions=2,
+        retrain_error_threshold=0.5,
+    )
+    assert pinned.retrain_error_threshold == 0.5

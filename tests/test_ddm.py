@@ -159,3 +159,69 @@ def test_step_and_batch_jit_and_vmap():
     out_state, res = f(states, errs, valid)
     assert out_state.count.shape == (4,)
     assert res.first_change.shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# noise_floor (config.DDMParams.noise_floor; DDM_ROBUST preset)
+# ---------------------------------------------------------------------------
+
+ROBUST = DDMParams(noise_floor=0.1)
+
+
+def run_oracle_floor(errs, params):
+    ddm = OracleDDM(
+        min_num_instances=params.min_num_instances,
+        warning_level=params.warning_level,
+        out_control_level=params.out_control_level,
+        noise_floor=params.noise_floor,
+    )
+    warns, changes = [], []
+    for e in errs:
+        ddm.add_element(float(e))
+        warns.append(ddm.in_warning)
+        changes.append(ddm.in_change)
+    return np.array(warns), np.array(changes)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_floor_step_and_batch_match_oracle(seed):
+    """Scalar scan and batch kernel agree with the floored oracle."""
+    rng = np.random.default_rng(seed)
+    errs = planted_stream(rng, 400, 250, p0=0.01, p1=0.7)
+    ow, oc = run_oracle_floor(errs, ROBUST)
+    _, (kw, kc) = ddm_scan(ddm_init(), jnp.asarray(errs), ROBUST)
+    assert np.array_equal(np.asarray(kw), ow)
+    assert np.array_equal(np.asarray(kc), oc)
+    # Batch kernel: first change position equals the oracle's first change.
+    _, res = ddm_batch(
+        ddm_init(), jnp.asarray(errs), jnp.ones(len(errs), bool), ROBUST
+    )
+    ofc = int(np.argmax(oc)) if oc.any() else -1
+    assert int(res.first_change) == ofc
+
+
+def test_floor_disarms_zero_minima_trap():
+    """A clean warm-up stretch then one stray error: classic DDM fires a
+    change off the zero-width band (the measured r04 'linear' over-firing
+    loop); the floored preset stays quiet but still detects a real jump."""
+    errs = np.zeros(200, np.float32)
+    errs[100] = 1.0  # single residual error after a clean stretch
+    _, (_, c_classic) = ddm_scan(ddm_init(), jnp.asarray(errs), REF_PARAMS)
+    _, (_, c_floor) = ddm_scan(ddm_init(), jnp.asarray(errs), ROBUST)
+    assert bool(np.asarray(c_classic).any())  # the trap, reproduced
+    assert not np.asarray(c_floor).any()  # the fix
+
+    jump = np.concatenate([np.zeros(100, np.float32), np.ones(60, np.float32)])
+    _, (_, c_jump) = ddm_scan(ddm_init(), jnp.asarray(jump), ROBUST)
+    fired = np.asarray(c_jump)
+    assert fired.any() and int(np.argmax(fired)) < 130  # prompt real detection
+
+
+def test_floor_zero_is_classic_ddm_bitwise():
+    rng = np.random.default_rng(7)
+    errs = planted_stream(rng, 300, 180)
+    explicit = DDMParams(noise_floor=0.0)
+    _, (w0, c0) = ddm_scan(ddm_init(), jnp.asarray(errs), REF_PARAMS)
+    _, (w1, c1) = ddm_scan(ddm_init(), jnp.asarray(errs), explicit)
+    assert np.array_equal(np.asarray(w0), np.asarray(w1))
+    assert np.array_equal(np.asarray(c0), np.asarray(c1))

@@ -147,3 +147,25 @@ def test_vmap_over_partitions_matches_individual_runs():
         np.testing.assert_array_equal(
             np.asarray(vflags.change_global[i]), np.asarray(runs[i].change_global)
         )
+
+
+def test_engine_rejects_unresolved_retrain_sentinel():
+    """The RETRAIN_AUTO sentinel (any negative threshold) must fail loudly
+    at the engine boundary instead of silently forcing a retrain every
+    batch (engine/loop._check_retrain_threshold)."""
+    import pytest as _pytest
+
+    from distributed_drift_detection_tpu.config import RETRAIN_AUTO, DDMParams
+    from distributed_drift_detection_tpu.engine.loop import make_partition_step
+    from distributed_drift_detection_tpu.engine.window import make_window_span
+    from distributed_drift_detection_tpu.models import ModelSpec, make_majority
+
+    model = make_majority(ModelSpec(3, 2))
+    with _pytest.raises(ValueError, match="RETRAIN_AUTO"):
+        make_partition_step(
+            model, DDMParams(), retrain_error_threshold=RETRAIN_AUTO
+        )
+    with _pytest.raises(ValueError, match="RETRAIN_AUTO"):
+        make_window_span(
+            model, DDMParams(), window=4, retrain_error_threshold=-0.5
+        )

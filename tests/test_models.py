@@ -176,3 +176,64 @@ def test_forest_rejects_bad_params():
         make_forest(SPEC, depth=0)
     with pytest.raises(ValueError, match="forest_depth"):
         make_forest(SPEC, depth=17)
+
+
+def test_saturation_guard_flags_match_config_registry():
+    """Model.saturation_guard (models/base.py) and config.GUARDED_MODELS are
+    the same fact in two places (one jax-free for the grid harness's trial
+    keys); they must never drift apart. majority is deliberately unguarded
+    (golden-oracle family — config.GUARDED_MODELS rationale)."""
+    from distributed_drift_detection_tpu.config import GUARDED_MODELS
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    spec = ModelSpec(num_features=4, num_classes=3)
+    for name in ("majority", "centroid", "gnb", "linear", "mlp", "forest"):
+        model = build_model(name, spec)
+        assert model.saturation_guard == (name in GUARDED_MODELS), name
+
+
+def test_resolve_retrain_threshold():
+    from distributed_drift_detection_tpu.config import (
+        AUTO_RETRAIN_THRESHOLD,
+        RETRAIN_AUTO,
+        RunConfig,
+        resolve_retrain_threshold,
+    )
+
+    # Auto default: guard for memorizer families, reference-exact otherwise.
+    assert (
+        resolve_retrain_threshold(RunConfig(model="gnb"))
+        == AUTO_RETRAIN_THRESHOLD
+    )
+    assert (
+        resolve_retrain_threshold(RunConfig(model="forest"))
+        == AUTO_RETRAIN_THRESHOLD
+    )
+    for name in ("centroid", "linear", "mlp", "majority", "rf"):
+        assert resolve_retrain_threshold(RunConfig(model=name)) is None, name
+    # Explicit None disables; explicit floats (0.0 is active) pin.
+    assert (
+        resolve_retrain_threshold(
+            RunConfig(model="gnb", retrain_error_threshold=None)
+        )
+        is None
+    )
+    assert (
+        resolve_retrain_threshold(
+            RunConfig(model="centroid", retrain_error_threshold=0.0)
+        )
+        == 0.0
+    )
+    assert (
+        resolve_retrain_threshold(
+            RunConfig(model="centroid", retrain_error_threshold=0.5)
+        )
+        == 0.5
+    )
+    # Any negative value is the sentinel.
+    assert RETRAIN_AUTO < 0 and (
+        resolve_retrain_threshold(
+            RunConfig(model="forest", retrain_error_threshold=-2.0)
+        )
+        == AUTO_RETRAIN_THRESHOLD
+    )

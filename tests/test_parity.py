@@ -119,7 +119,11 @@ def test_group_by_geometry_keeps_criteria_per_stream():
     # criteria computed per group: centroid is earlier on rialto, 4 units
     # later (within one worker-batch = 8) on outdoorStream — both pass.
     msgs = []
-    assert report(rialto + outdoor, progress=msgs.append)
+    # required pinned to the swept family: this synthetic fixture measures
+    # only centroid (the shipped default REQUIRED_MODELS gate covers every
+    # on-device family and would correctly refuse this partial sweep).
+    assert report(rialto + outdoor, progress=msgs.append,
+                  required=("centroid",))
     assert sum("===" in m for m in msgs) == 2
     # pooled (the bug the grouping prevents) would compare 32.0 vs 35.0 and
     # hide the per-stream structure entirely
@@ -138,7 +142,7 @@ def test_report_verdict_semantics():
         + _rows("slowpoke", [61.0])
     )
     msgs = []
-    ok = report(rows, progress=msgs.append)
+    ok = report(rows, progress=msgs.append, required=("centroid",))
     assert sum(m.startswith("centroid:") for m in msgs) == 1
     assert sum(m.startswith("slowpoke:") for m in msgs) == 1
     assert ok  # slowpoke FAILs both axes but is not required
@@ -242,3 +246,28 @@ def test_parity_criteria_hold_on_outdoorstream_geometry():
     for m in ("centroid", "gnb"):
         assert gaps[m] <= partitions, (m, gaps[m])
         assert spur[m] <= SPURIOUS_TOLERANCE, (m, spur[m])
+
+
+@pytest.mark.slow
+def test_guarded_families_detect_on_rialto_standin():
+    """VERDICT r4 #1 end-to-end: at DEFAULT config (auto saturation guard)
+    the memorizer families no longer ship recall 0.000 on the rialto
+    stand-in, and the shipped linear@robust preset (DDM_ROBUST noise
+    floor) detects without the raw-sensitivity over-firing loop."""
+    rows = measure_delay_parity(
+        models=("gnb", "forest", "linear", "linear@robust"),
+        mult_data=2.0,
+        partitions=8,
+        seeds=range(1),
+    )
+    by_model = {r["model"]: r for r in rows}
+    for m in ("gnb", "forest", "linear@robust"):
+        r = by_model[m]
+        assert r["recall"] > 0.5, (m, r)
+        assert np.isfinite(r["mean_delay_batches"]), (m, r)
+    # The preset's point: same family, ~an order of magnitude fewer
+    # spurious fires than the raw 3/0.5/1.5 sensitivity.
+    assert (
+        by_model["linear@robust"]["spurious"]
+        < by_model["linear"]["spurious"] / 4
+    ), (by_model["linear"], by_model["linear@robust"])
