@@ -1,0 +1,235 @@
+"""Measure the zoo's two owed deviation quantifications (VERDICT r4 #2/#4).
+
+Runs host-side only (the chunked/kernel side is represented by the
+test-pinned mirror oracles — ``tests/test_golden.py`` proves the JAX
+kernels bit-match them, so measuring the oracles measures the kernels).
+Writes ``results/detector_deviations.json``; the numbers are quoted in
+PARITY.md "Detector exactness".
+
+1. **ADWIN clock-split** (``ops/adwin.py`` "TPU restructuring"): the kernel
+   fuses bucket granularity and check cadence into one ``clock``. Compared
+   per stream seed against the *classic* form (element-granularity buckets,
+   ``tests/classic.py``) at the same check cadence (32, the classic
+   implementations' default) and at cadence 1 (the textbook maximum):
+   detection rate, first-detection delay after the planted jump, false
+   alarms before it.
+
+2. **KSWIN** (``config.KSWINParams`` deviations): the kernel form
+   (full-older-window sample + asymptotic critical value) vs the published
+   form (``stat_size`` subsample with replacement + scipy's exact
+   two-sample KS p-value + retain-recent-on-change), which is stochastic —
+   classic numbers are over subsample draws. The third deviation
+   (empty-on-reset re-arm) is deterministic: re-arm spans are measured
+   directly with a drift/recover/drift stream.
+
+Usage: python results/measure_detector_deviations.py  (from the repo root)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tests"))
+sys.path.insert(0, os.path.dirname(HERE))
+
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tests", "golden"))
+
+from classic import ClassicADWIN, ClassicKSWIN  # noqa: E402
+from generate import make_stream  # noqa: E402  (canonical stream builder)
+from test_detectors import OracleADWIN, OracleKSWIN  # noqa: E402
+
+from distributed_drift_detection_tpu.config import (  # noqa: E402
+    ADWINParams,
+    KSWINParams,
+)
+
+
+def stream(seed, n, flip_at, p0, p1):
+    return make_stream(dict(seed=seed, n=n, flip_at=flip_at, p0=p0, p1=p1))
+
+
+def first_change_stats(det_factory, errs, flip_at, reset_on_change=True):
+    """Caller-reset protocol (the engines'): feed elements, reset the
+    detector after each change. Returns (false_alarms_before_flip,
+    first_detection_delay_after_flip_or_None)."""
+    det = det_factory()
+    false_alarms, delay = 0, None
+    for i, e in enumerate(errs):
+        det.add_element(float(e))
+        if det.in_change:
+            if i < flip_at:
+                false_alarms += 1
+            elif delay is None:
+                delay = i - flip_at
+                break
+            if reset_on_change:
+                det = det_factory()
+    return false_alarms, delay
+
+
+def adwin_block():
+    p = ADWINParams()  # delta=0.002, clock=32
+    seeds, n, flip_at = range(10), 30_000, 15_000
+    variants = {
+        "chunked_clock32(kernel)": lambda: OracleADWIN(p),
+        "classic_check32": lambda: ClassicADWIN(
+            delta=p.delta, check_every=32, max_buckets=p.max_buckets,
+            max_levels=p.max_levels, min_window=p.min_window,
+            min_side=p.min_side,
+        ),
+        "classic_check1(textbook)": lambda: ClassicADWIN(
+            delta=p.delta, check_every=1, max_buckets=p.max_buckets,
+            max_levels=p.max_levels, min_window=p.min_window,
+            min_side=p.min_side,
+        ),
+    }
+    out = {}
+    for name, factory in variants.items():
+        fas, delays, misses = [], [], 0
+        for s in seeds:
+            errs = stream(s, n, flip_at, 0.05, 0.3)
+            fa, d = first_change_stats(factory, errs, flip_at)
+            fas.append(fa)
+            if d is None:
+                misses += 1
+            else:
+                delays.append(d)
+        out[name] = {
+            "streams": len(list(seeds)),
+            "missed": misses,
+            "false_alarms_total": int(np.sum(fas)),
+            "delay_mean_elements": round(float(np.mean(delays)), 1),
+            "delay_std_elements": round(float(np.std(delays)), 1),
+        }
+    return out
+
+
+def kswin_block():
+    p = KSWINParams()  # alpha=0.005, window 100, stat 30
+    seeds, n, flip_at = range(8), 6_000, 3_000
+    out = {}
+
+    fas, delays, misses = [], [], 0
+    for s in seeds:
+        errs = stream(s, n, flip_at, 0.05, 0.6)
+        fa, d = first_change_stats(lambda: OracleKSWIN(p), errs, flip_at)
+        fas.append(fa)
+        if d is None:
+            misses += 1
+        else:
+            delays.append(d)
+    out["kernel_form(full_older+asymptotic)"] = {
+        "streams": len(list(seeds)),
+        "missed": misses,
+        "false_alarms_total": int(np.sum(fas)),
+        "delay_mean_elements": round(float(np.mean(delays)), 1),
+        "delay_std_elements": round(float(np.std(delays)), 1),
+    }
+
+    # Classic form is stochastic (subsample draw) — 3 draws per stream.
+    fas, delays, misses, runs = [], [], 0, 0
+    for s in seeds:
+        errs = stream(s, n, flip_at, 0.05, 0.6)
+        for sub in range(3):
+            runs += 1
+            rng = np.random.default_rng(1000 * s + sub)
+            fa, d = first_change_stats(
+                lambda: ClassicKSWIN(
+                    alpha=p.alpha, window_size=p.window_size,
+                    stat_size=p.stat_size, rng=rng,
+                ),
+                errs,
+                flip_at,
+                reset_on_change=False,  # classic self-manages its window
+            )
+            fas.append(fa)
+            if d is None:
+                misses += 1
+            else:
+                delays.append(d)
+    out["classic_form(subsample+exact_p+retain)"] = {
+        "runs": runs,
+        "missed": misses,
+        "false_alarms_total": int(np.sum(fas)),
+        "delay_mean_elements": round(float(np.mean(delays)), 1),
+        "delay_std_elements": round(float(np.std(delays)), 1),
+    }
+
+    # Re-arm after a detection (deviation 3, deterministic): drift at t1;
+    # after the detection the stream returns in-control; a second drift at
+    # t1+gap — the smallest gap each variant re-detects measures its
+    # re-arm span.
+    def rearm(variant):
+        for gap in range(10, 301, 10):
+            t1, t2 = 500, 500 + gap
+            n2 = t2 + 400
+            rng = np.random.default_rng(99)
+            probs = np.full(n2, 0.02)
+            probs[t1 : t1 + 40] = 0.95  # first drift burst
+            probs[t2:] = 0.95  # second drift
+            errs = (rng.random(n2) < probs).astype(np.float32)
+            if variant == "kernel":
+                det = OracleKSWIN(p)
+                seen_first = False
+                det_t = None
+                i = 0
+                while i < n2:
+                    det.add_element(float(errs[i]))
+                    if det.in_change:
+                        if not seen_first:
+                            seen_first = True
+                            det = OracleKSWIN(p)  # engine empty-reset
+                        elif i >= t2:
+                            det_t = i
+                            break
+                    i += 1
+            else:
+                det = ClassicKSWIN(
+                    alpha=p.alpha, window_size=p.window_size,
+                    stat_size=p.stat_size,
+                    rng=np.random.default_rng(7),
+                )
+                seen_first = False
+                det_t = None
+                for i in range(n2):
+                    det.add_element(float(errs[i]))
+                    if det.in_change:
+                        if not seen_first:
+                            seen_first = True  # classic retains stat_size
+                        elif i >= t2:
+                            det_t = i
+                            break
+            if det_t is not None:
+                return gap
+        return None
+
+    out["rearm_min_gap_elements"] = {
+        "kernel_empty_reset": rearm("kernel"),
+        "classic_retain_stat_size": rearm("classic"),
+        "note": (
+            "kernel re-arms after window_size fresh elements, classic after "
+            "window_size - stat_size; at the benchmark geometries "
+            "(>=512-element per-partition concepts) the extra stat_size "
+            "elements of blindness cost 0 missed boundaries (grid artifact: "
+            "kswin recall 1.000 on outdoorStream x64)"
+        ),
+    }
+    return out
+
+
+def main():
+    out = {"adwin": adwin_block(), "kswin": kswin_block()}
+    path = os.path.join(HERE, "detector_deviations.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Golden-trace pinning: JAX kernels vs the committed detector traces.
+
+``tests/golden/traces.json`` (generated once by ``tests/golden/generate.py``,
+committed) holds per-element warning/change index traces for every zoo
+member on seeded planted-jump streams, produced by independent host
+implementations — including the *textbook* element-granularity ADWIN
+(``tests/classic.py``), which the kernel must coincide with at ``clock=1``
+(ADVICE r4: a restructuring error shared by kernel and mirroring oracle
+cannot survive this test). Any kernel change that moves a flag against the
+committed JSON is a contract break, not a refactor.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_drift_detection_tpu.config import (
+    ADWINParams,
+    DDMParams,
+    EDDMParams,
+    HDDMParams,
+    HDDMWParams,
+    KSWINParams,
+    PHParams,
+    STEPDParams,
+)
+from distributed_drift_detection_tpu.ops.adwin import adwin_init, adwin_step
+from distributed_drift_detection_tpu.ops.ddm import ddm_init, ddm_scan
+from distributed_drift_detection_tpu.ops.detectors import (
+    eddm_init,
+    eddm_step,
+    hddm_init,
+    hddm_step,
+    hddm_w_init,
+    hddm_w_step,
+    kswin_init,
+    kswin_step,
+    ph_init,
+    ph_step,
+    stepd_init,
+    stepd_step,
+)
+
+TRACES = os.path.join(os.path.dirname(__file__), "golden", "traces.json")
+
+
+def _generator():
+    """Import tests/golden/generate.py (the canonical fixture generator —
+    its make_stream is the single stream-reconstruction implementation)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "golden"))
+    try:
+        import generate
+    finally:
+        sys.path.pop(0)
+    return generate
+
+KERNELS = {
+    "ddm": (DDMParams, lambda p: ddm_init(), None),
+    "ph": (PHParams, lambda p: ph_init(), ph_step),
+    "eddm": (EDDMParams, lambda p: eddm_init(), eddm_step),
+    "hddm": (HDDMParams, lambda p: hddm_init(), hddm_step),
+    "hddm_w": (HDDMWParams, lambda p: hddm_w_init(), hddm_w_step),
+    "adwin": (ADWINParams, adwin_init, adwin_step),
+    "kswin": (KSWINParams, kswin_init, kswin_step),
+    "stepd": (STEPDParams, stepd_init, stepd_step),
+}
+
+
+def _cases():
+    with open(TRACES) as fh:
+        return json.load(fh)
+
+
+def _stream(spec):
+    return _generator().make_stream(spec)
+
+
+@pytest.mark.parametrize("case", _cases(), ids=lambda c: c["case"])
+def test_kernel_matches_committed_trace(case):
+    params_cls, init, step = KERNELS[case["detector"]]
+    params = params_cls(**case["params"])
+    errs = jnp.asarray(_stream(case["stream"]))
+    if step is None:  # ddm: the dedicated scan entry
+        _, (warn, change) = ddm_scan(ddm_init(), errs, params)
+    else:
+        _, (warn, change) = lax.scan(
+            lambda c, e: step(c, e, params), init(params), errs
+        )
+    k_warn = np.flatnonzero(np.asarray(warn)).tolist()
+    k_change = np.flatnonzero(np.asarray(change)).tolist()
+    assert k_change == case["changes"], case["case"]
+    assert k_warn == case["warnings"], case["case"]
+
+
+def test_traces_are_regenerable():
+    """The committed JSON matches what generate.py produces today — the
+    generating implementations and the fixture cannot silently drift apart
+    (a change to either is a deliberate regeneration + diff)."""
+    assert _generator().build_cases() == _cases()
+
+
+def test_textbook_adwin_case_present():
+    """The ADVICE r4 cross-check is part of the committed contract: the
+    clock=1 kernel coincides with the *classic* per-element-bucket ADWIN
+    (source='classic'), not merely with the chunked-spec oracle."""
+    cases = _cases()
+    textbook = [
+        c
+        for c in cases
+        if c["detector"] == "adwin" and c["source"] == "classic"
+    ]
+    assert len(textbook) >= 3  # every stream profile
+    assert all(c["params"]["clock"] == 1 for c in textbook)
+    assert any(c["changes"] for c in textbook)  # detection-bearing
