@@ -23,23 +23,29 @@ additionally rides a ``soak_xl_*`` block: the same chained-only branch at a
 The first device interaction of a fresh process over the remote-TPU tunnel
 can absorb tens of seconds of one-time setup (device init, remote compile
 service) that a single warm-up does not always amortise, and individual
-repetitions occasionally catch multi-second stalls of the shared tunnel
-itself. The benchmark therefore runs two warm-ups and reports the **median
-of nine timed repetitions** — the closest robust analog of the reference's
+repetitions catch multi-second stalls of the shared tunnel itself — r01-r04
+recorded headline swings of 2× with bit-identical flags from exactly this.
+The benchmark therefore runs two warm-ups and **15 timed repetitions with
+stall-aware selection** (VERDICT r4 #3): any repetition slower than 1.5×
+the invocation's fastest is classified a stall (the fastest repetition is
+stall-free by construction, and a real regression moves the fastest too,
+so regressions cannot be filtered away), and the headline is the median of
+the non-stalled repetitions — the closest robust analog of the reference's
 trial-mean methodology (means of ≥4 trials on a warm, dedicated cluster,
-BASELINE.md) under noisy measurement infrastructure. Because a stalled
-median is indistinguishable from a real regression after the fact, the
-JSON line also carries the full per-repetition record: ``rep_times_s``
-(all nine spans), ``final_time_min_s`` (the min — the cleanest view of
-what the code can do), and ``phase_s`` (per-repetition
-upload/detect/collect breakdown via ``utils.timing.PhaseTimer``; ``detect``
-is the pure device-execution span, measured to ``block_until_ready``) — so
-a tunnel stall is visible *in the artifact*: it shows up as outlier
-repetitions whose excess lives in ``upload``/``collect`` (host↔device
-link) rather than ``detect`` (device compute).
+BASELINE.md) under noisy measurement infrastructure. The JSON line carries
+the evidence: ``stalled_reps`` (the excluded indices), ``contended``
+(≥half the reps stalled — treat the headline with suspicion),
+``rep_times_s`` (all 15 spans), ``final_time_min_s``, ``detect_time_s``
+(median non-stalled device-execution span — the detect phase is closed by
+a 1-element d2h fetch because ``block_until_ready`` alone is unreliable
+over this tunnel), and ``phase_s`` (per-repetition upload/detect/collect
+breakdown) — so a tunnel stall is visible *in the artifact*: excess in
+``upload``/``collect`` (host↔device link) rather than ``detect`` (device
+compute).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -247,6 +253,169 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
     }
 
 
+# --------------------------------------------------------------------------
+# Host-fed sustained benchmark (VERDICT r4 #6: the SURVEY §7 "host-feed
+# bandwidth" hard part, measured on hardware instead of argued).
+# --------------------------------------------------------------------------
+
+# ~2.1 GB on-disk stream: 10 class-concepts × 1.15 M rows of 27 features —
+# the rialto shape at ~25× its volume, in the sorted-by-target layout the
+# benchmark pipeline uses (each class is one concept; boundary = drift).
+CHUNKED_CLASSES = 10
+CHUNKED_ROWS_PER_CLASS = 1_150_000
+CHUNKED_DISTINCT = 10_000  # distinct rows per class, tiled to volume
+CHUNKED_PATH = "/root/repo/.bench_data/chunked_stream.csv"
+
+
+def _ensure_chunked_file(path: str = CHUNKED_PATH) -> int:
+    """Create (once, ~2.1 GB, seeded) the on-disk stream; returns its rows.
+
+    Rows within a class tile a 10k-row distinct sample — byte-level block
+    tiling writes multi-GB in seconds, and duplicated in-concept rows are
+    exactly what the benchmark's ``mult_data`` duplication produces anyway.
+    The file is a cache artifact (gitignored), deterministic in content.
+    """
+    total = CHUNKED_CLASSES * CHUNKED_ROWS_PER_CLASS
+    if os.path.exists(path):
+        return total
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rng = np.random.default_rng(42)
+    protos = rng.normal(size=(CHUNKED_CLASSES, 27)).astype(np.float32) * 1.6
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(27)) + ",target\n")
+        reps = CHUNKED_ROWS_PER_CLASS // CHUNKED_DISTINCT
+        for c in range(CHUNKED_CLASSES):
+            X = protos[c] + 0.4 * rng.normal(
+                size=(CHUNKED_DISTINCT, 27)
+            ).astype(np.float32)
+            lines = [
+                ",".join(f"{v:.4f}" for v in row) + f",{c}\n" for row in X
+            ]
+            block = "".join(lines)
+            for _ in range(reps):
+                fh.write(block)
+    os.replace(tmp, path)
+    return total
+
+
+def _chunked_stats() -> dict:
+    """Drive the on-disk stream through native ingest → ChunkedDetector.
+
+    Two measured passes over the same file:
+      * ``parse`` — drain ``io.feeder.csv_chunks`` alone (block reads +
+        native multithreaded parse + striping), no device: the host-feed
+        bandwidth ceiling.
+      * ``overlapped`` — the shipped pipeline: ``prefetch_chunks`` producer
+        thread + ``ChunkedDetector.feed`` with JAX async dispatch, so chunk
+        N+1 parses while chunk N computes.
+    ``overlap_efficiency = parse_time / overlapped_time`` → 1.0 means the
+    device compute is fully hidden behind the feed (the SURVEY §7
+    double-buffering claim, measured); the headline is overlapped rows/s.
+    """
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.feeder import (
+        csv_chunks,
+        prefetch_chunks,
+    )
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    total_rows = _ensure_chunked_file()
+    p, b, cb, window = 16, 100, 128, 128  # 204.8k-row chunks, W=128 spans
+    feeder = lambda: csv_chunks(CHUNKED_PATH, p, b, cb)  # noqa: E731
+
+    # Warm the page cache first so BOTH passes read the file warm — a
+    # freshly written file would otherwise give pass 1 a cold-cache read
+    # and bias overlap_efficiency upward.
+    with open(CHUNKED_PATH, "rb") as fh:
+        while fh.read(64 << 20):
+            pass
+
+    # Pass 1: host-feed ceiling (no device work at all).
+    start = time.perf_counter()
+    parsed_rows = 0
+    for chunk in feeder():
+        parsed_rows += int(chunk.valid.sum())
+    parse_s = time.perf_counter() - start
+
+    # Pass 2: the shipped overlapped pipeline. Compile warm-up happens on
+    # SYNTHETIC chunks (both shape paths: the carry-seeding first feed
+    # loses a batch, steady chunks are full), after which the detector
+    # state is reset — so the timed span covers the *entire* real pipeline
+    # from cold (including the prefetch producer's spin-up: starting the
+    # timer mid-stream would let up to `depth` pre-parsed chunks ride in
+    # free, biasing the rate up) with zero compile cost inside it.
+    parse_rate = parsed_rows / parse_s
+    model = build_model("centroid", ModelSpec(27, CHUNKED_CLASSES))
+    det = ChunkedDetector(
+        model, partitions=p, seed=0, window=window, rotations=1
+    )
+    from distributed_drift_detection_tpu.io.stream import stripe_chunk
+
+    rows_chunk = p * b * cb
+    for i in range(2):
+        warm = stripe_chunk(
+            np.zeros((rows_chunk, 27), np.float32),
+            np.zeros(rows_chunk, np.int32),
+            i * rows_chunk, p, b, cb,
+        )
+        np.asarray(det.feed(warm).change_global)
+    det.carry = None  # discard warm-up state; executables stay cached
+    det.batches_done = 0
+
+    flags_async = []
+    rows_done = 0
+    start = time.perf_counter()
+    for chunk in prefetch_chunks(feeder(), depth=2):
+        flags_async.append(det.feed(chunk))
+        rows_done += int(chunk.valid.sum())  # numpy, no device sync
+    np.asarray(flags_async[-1].change_global)  # final device sync
+    overlapped_s = time.perf_counter() - start
+    overlapped_rate = rows_done / overlapped_s
+    detections = sum(
+        int((np.asarray(f.change_global) >= 0).sum()) for f in flags_async
+    )
+
+    return {
+        "value": round(overlapped_rate, 1),
+        "vs_baseline": round(overlapped_rate / BASELINE_ROWS_PER_SEC, 2),
+        "rows": total_rows,
+        "measured_rows": rows_done,
+        "parsed_rows": parsed_rows,
+        "file_bytes": os.path.getsize(CHUNKED_PATH),
+        "time_s": round(overlapped_s, 4),
+        "parse_only_s": round(parse_s, 4),
+        "parse_rows_per_sec": round(parse_rate, 1),
+        # Fraction of the parse-only feed rate sustained with device
+        # compute attached: → 1.0 means compute fully hidden behind the
+        # feed (the SURVEY §7 double-buffering claim, measured).
+        "overlap_efficiency": round(overlapped_rate / parse_rate, 3),
+        "partitions": p,
+        "chunk_batches": cb,
+        "window": window,
+        "detections": detections,
+        "planted_boundaries": CHUNKED_CLASSES - 1,
+    }
+
+
+def chunked() -> None:
+    """--chunked mode: print the host-fed sustained stats as the JSON line."""
+    import jax
+
+    _enable_compile_cache(jax)
+    stats = _chunked_stats()
+    print(
+        json.dumps(
+            {
+                "metric": "chunked_rows_per_sec_chip",
+                "unit": "rows/s",
+                **stats,
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
+
 def soak(total_rows: int) -> None:
     """--soak mode: print the soak stats as the one JSON line."""
     import jax
@@ -327,20 +496,29 @@ def main() -> None:
         np.asarray(runner(db, dk).packed)
 
     # Timed runs — each spans the reference's Final Time
-    # (upload + detect + collect + delay metric); report the median of 9
-    # plus the full per-repetition and per-phase record (module docstring:
-    # the artifact itself must distinguish a tunnel stall from a real
-    # regression).
+    # (upload + detect + collect + delay metric). Contention-robust headline
+    # (VERDICT r4 #3 — the shared tunnel's stalls moved recorded headlines
+    # 2× across rounds): 15 repetitions; a repetition whose span exceeds
+    # 1.5× the invocation's fastest is classified a *stall* (the fastest
+    # rep is by construction stall-free; real regressions move the fastest
+    # rep too, so they cannot be misclassified away), and the headline is
+    # the median of the non-stalled repetitions. The full per-repetition
+    # and per-phase record still rides in the JSON — including
+    # ``detect_time_s`` (the device-execution span, closed by a 1-element
+    # d2h fetch because ``block_until_ready`` alone is unreliable over this
+    # tunnel) so stalls are separable from compute in the artifact itself.
+    REPS, STALL_FACTOR = 15, 1.5
     times = []
     phases = {"upload": [], "detect": [], "collect": []}
-    for _ in range(9):
+    for _ in range(REPS):
         timer = PhaseTimer()
         start = time.perf_counter()
         with timer.phase("upload"):
             db, dk = shard_batches(batches, keys, mesh)
         with timer.phase("detect"):
             out = runner(db, dk)
-            jax.block_until_ready(out)  # pure device-execution span
+            jax.block_until_ready(out)
+            np.asarray(out.packed[:1, :1])  # force a real device sync
         with timer.phase("collect"):
             change_global = unpack_flags(np.asarray(out.packed)).change_global
             m = delay_metrics(
@@ -349,7 +527,13 @@ def main() -> None:
         times.append(time.perf_counter() - start)
         for k, v in timer.as_dict().items():
             phases[k].append(round(v, 4))
-    elapsed = float(np.median(times))
+    floor_t = min(times)
+    stalled = [i for i, t in enumerate(times) if t > STALL_FACTOR * floor_t]
+    clean = [t for i, t in enumerate(times) if i not in stalled]
+    elapsed = float(np.median(clean))
+    detect_clean = [
+        t for i, t in enumerate(phases["detect"]) if i not in stalled
+    ]
 
     rows_per_sec = stream.num_rows / elapsed
     delay_batches = m.mean_delay_batches
@@ -405,6 +589,24 @@ def main() -> None:
                 f"contended tunnel (soak_time_s={soak_t}); see "
                 "results/soak_xl_r04.json or run bench.py --soak 3e9"
             )
+        # Host-fed sustained rider (VERDICT r4 #6): the on-disk ~2.1 GB
+        # stream through native ingest + ChunkedDetector. Same contention
+        # guard as the xl soak — parse-bound, so a contended host makes it
+        # meaningless rather than merely slow.
+        if soak_t is not None and soak_t <= 30.0:
+            try:
+                soak_stats.update(
+                    {f"chunked_{k}": v for k, v in _chunked_stats().items()}
+                )
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                soak_stats["chunked_error"] = f"{type(e).__name__}: {e}"[:300]
+        else:
+            soak_stats["chunked_skipped"] = (
+                "contended tunnel or failed soak; run bench.py --chunked"
+            )
     else:
         soak_stats = {"soak_skipped": "non-TPU device; use --soak explicitly"}
 
@@ -416,7 +618,14 @@ def main() -> None:
                 "unit": "rows/s",
                 "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 2),
                 "final_time_s": round(elapsed, 4),
-                "final_time_min_s": round(min(times), 4),
+                "final_time_min_s": round(floor_t, 4),
+                # Device-execution time (true-synced detect phase) of the
+                # non-stalled reps: the compute-only view the wall-clock
+                # headline is judged against.
+                "detect_time_s": round(float(np.median(detect_clean)), 4),
+                "reps": REPS,
+                "stalled_reps": stalled,  # indices excluded from the median
+                "contended": len(stalled) >= (REPS + 1) // 2,
                 "rep_times_s": [round(t, 4) for t in times],
                 "phase_s": phases,
                 "rows": stream.num_rows,
@@ -438,21 +647,27 @@ def main() -> None:
 
 if __name__ == "__main__":
     is_soak = len(sys.argv) > 1 and sys.argv[1] == "--soak"
+    is_chunked = len(sys.argv) > 1 and sys.argv[1] == "--chunked"
     try:
         if is_soak:
             soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
+        elif is_chunked:
+            chunked()
         else:
             main()
     except Exception as e:  # still emit ONE parseable JSON line on failure
         import traceback
 
         traceback.print_exc(file=sys.stderr)  # full diagnostic to stderr
+        metric = "rows_per_sec_chip"
+        if is_soak:
+            metric = "soak_rows_per_sec_chip"
+        elif is_chunked:
+            metric = "chunked_rows_per_sec_chip"
         print(
             json.dumps(
                 {
-                    "metric": (
-                        "soak_rows_per_sec_chip" if is_soak else "rows_per_sec_chip"
-                    ),
+                    "metric": metric,
                     "value": None,
                     "unit": "rows/s",
                     "vs_baseline": None,
