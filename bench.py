@@ -312,6 +312,12 @@ def _chunked_stats() -> dict:
     ``overlap_efficiency = parse_time / overlapped_time`` → 1.0 means the
     device compute is fully hidden behind the feed (the SURVEY §7
     double-buffering claim, measured); the headline is overlapped rows/s.
+
+    Regime note (r05 captures): over the shared remote-TPU *tunnel* the
+    per-chunk h2d transfer (~22 MB) is the bottleneck — efficiency ~0.27,
+    transport-bound; the same code on a local device is parse-bound
+    (efficiency 0.73 on the CPU backend). Both regimes are the
+    measurement's point: ingest, not FLOPs, bounds this path.
     """
     from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
     from distributed_drift_detection_tpu.io.feeder import (

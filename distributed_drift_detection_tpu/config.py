@@ -531,6 +531,22 @@ def resolve_retrain_threshold(cfg: RunConfig) -> float | None:
     return AUTO_RETRAIN_THRESHOLD if cfg.model in GUARDED_MODELS else None
 
 
+def parse_model_spec(spec: str) -> tuple[str, dict]:
+    """Parse a ``family[@variant]`` model spec → (family, RunConfig kwargs).
+
+    The one grammar shared by the parity harness's sweep specs and the
+    zoo examples: ``@robust`` selects the shipped ``DDM_ROBUST`` detector
+    preset; unknown variants fail loudly here rather than leaking a bogus
+    family name downstream.
+    """
+    family, _, variant = spec.partition("@")
+    if variant == "robust":
+        return family, {"ddm": DDM_ROBUST}
+    if variant:
+        raise ValueError(f"unknown model variant {spec!r}; known: @robust")
+    return family, {}
+
+
 def host_shuffle_seed(cfg: RunConfig) -> int | None:
     """The stripe-time shuffle seed a config implies (None = no shuffle).
 

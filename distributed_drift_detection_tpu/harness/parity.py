@@ -123,19 +123,12 @@ def measure_delay_parity(
     the model family alone — the comparison the criterion needs.
     """
     from ..api import run
-    from ..config import DDM_ROBUST, RunConfig
+    from ..config import RunConfig, parse_model_spec
     from ..metrics import attribution_metrics
 
     rows = []
     for model in models:
-        family, _, variant = model.partition("@")
-        extra = {}
-        if variant == "robust":
-            extra["ddm"] = DDM_ROBUST
-        elif variant:
-            raise ValueError(
-                f"unknown model variant {model!r}; known: @robust"
-            )
+        family, extra = parse_model_spec(model)
         for seed in seeds:
             cfg = RunConfig(
                 dataset=dataset,

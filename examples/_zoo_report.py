@@ -11,11 +11,23 @@ from distributed_drift_detection_tpu.metrics import attribution_metrics
 
 
 def zoo_report(base, field: str, names) -> None:
-    """Print one attribution row per variant: ``replace(base, field=name)``."""
-    print(f"{field:<10} {'detections':>10} {'hits':>6} {'spurious':>9} "
+    """Print one attribution row per variant: ``replace(base, field=name)``.
+
+    Model names go through the shared ``family[@variant]`` grammar
+    (``config.parse_model_spec``) — e.g. ``linear@robust``, the gated form
+    of linear with the shipped ``DDM_ROBUST`` detector preset.
+    """
+    from distributed_drift_detection_tpu.config import parse_model_spec
+
+    print(f"{field:<14} {'detections':>10} {'hits':>6} {'spurious':>9} "
           f"{'recall':>7} {'first-hit delay':>16} {'Final Time (s)':>15}")
     for name in names:
-        res = run(replace(base, **{field: name}))
+        if field == "model":
+            family, extra = parse_model_spec(name)
+            kw = {"model": family, **extra}
+        else:
+            kw = {field: name}
+        res = run(replace(base, **kw))
         m = res.metrics
         a = attribution_metrics(
             res.flags.change_global,
@@ -23,6 +35,6 @@ def zoo_report(base, field: str, names) -> None:
             res.stream.num_rows,
         )
         fh = f"{a.mean_first_hit_delay_rows:.1f}" if a.hits else "-"
-        print(f"{name:<10} {m.num_detections:>10} {a.hits:>6} "
+        print(f"{name:<14} {m.num_detections:>10} {a.hits:>6} "
               f"{a.spurious:>9} {a.recall:>7.3f} {fh:>16} "
               f"{res.total_time:>15.3f}")

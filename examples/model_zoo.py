@@ -33,7 +33,13 @@ def main():
         results_csv="",
     )
     zoo_report(
-        base, "model", ("majority", "centroid", "gnb", "linear", "mlp", "forest")
+        base,
+        "model",
+        # linear appears twice: raw reference sensitivity (documented
+        # over-firing on rialto-like regimes) and the shipped gated form
+        # with the DDM_ROBUST excursion floor (config.DDM_ROBUST).
+        ("majority", "centroid", "gnb", "linear", "linear@robust", "mlp",
+         "forest"),
     )
 
 

@@ -55,7 +55,10 @@ def test_detector_zoo_example(tmp_path):
 def test_model_zoo_example(tmp_path):
     # same contract as the detector zoo: every family runs and reports
     out = run_example(tmp_path, "model_zoo.py", "synth:rialto,seed=0", 1, 4)
-    for name in ("majority", "centroid", "gnb", "linear", "mlp", "forest"):
+    for name in (
+        "majority", "centroid", "gnb", "linear", "linear@robust", "mlp",
+        "forest",
+    ):
         assert f"\n{name} " in out, f"model {name} row missing:\n{out}"
 
 
