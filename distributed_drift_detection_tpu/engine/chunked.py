@@ -127,6 +127,11 @@ class ChunkedDetector:
             def run_chunk(carry: LoopCarry, batches: Batches):
                 return lax.scan(step, carry, batches)
 
+        # (Transport-dtype seam: feeders may ship the feature plane in a
+        # narrower dtype — stripe_chunk(feature_dtype=ml_dtypes.bfloat16)
+        # halves host→device bytes on transport-bound feeds; the ENGINES
+        # cast the plane back to f32 on device, engine/loop + engine/window,
+        # so every driver gets f32 compute for free.)
         # ``mesh``: shard the partition axis over devices, exactly like the
         # one-shot mesh runner (parallel.mesh) — every carry/chunk/flag leaf
         # is partition-major, so one sharding prefix covers the trees.
@@ -160,7 +165,7 @@ class ChunkedDetector:
             ddm=jax.vmap(lambda _: self._detector.init())(
                 jnp.arange(self.partitions)
             ),
-            a_X=first.X[:, 0],
+            a_X=first.X[:, 0].astype(jnp.float32),  # transport-dtype seam
             a_y=first.y[:, 0],
             a_w=first.valid[:, 0].astype(jnp.float32),
             retrain=jnp.ones(self.partitions, bool),

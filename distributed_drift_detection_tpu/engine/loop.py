@@ -193,6 +193,10 @@ def make_partition_step(
 
     def step(carry: LoopCarry, batch) -> tuple[LoopCarry, FlagRows]:
         b_X, b_y, b_rows, b_valid = batch
+        if b_X.dtype != jnp.float32:
+            # Transport-dtype seam (io.stream.stripe_chunk feature_dtype):
+            # narrower planes ship over the link, engines compute in f32.
+            b_X = b_X.astype(jnp.float32)
         key, k_shuf, k_fit = jax.random.split(carry.key, 3)
         if shuffle:
             perm = jax.random.permutation(k_shuf, b_y.shape[0])

@@ -143,6 +143,9 @@ def make_window_span(
             tail = jnp.full((w, *x.shape[1:]), fill, x.dtype)
             return jnp.concatenate([x, tail], axis=0)
 
+        if (not indexed) and batches.X.dtype != jnp.float32:
+            # Transport-dtype seam: engines compute in f32 (engine/loop).
+            batches = batches._replace(X=batches.X.astype(jnp.float32))
         if indexed:
             # Compressed stream: slice index planes, gather X/y from the
             # (replicated, cache-resident) row table on device.

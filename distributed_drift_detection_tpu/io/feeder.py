@@ -30,14 +30,23 @@ def chunk_stream_arrays(
     chunk_batches: int,
     start_row: int = 0,
     shuffle_seed: int | None = None,
+    feature_dtype=np.float32,
 ) -> Iterator[Batches]:
-    """Chunk an in-memory stream; rows are global positions + start_row."""
+    """Chunk an in-memory stream; rows are global positions + start_row.
+
+    ``feature_dtype`` is the transport dtype of the feature plane
+    (``stripe_chunk``): ``ml_dtypes.bfloat16`` halves host→device bytes
+    for transport-bound feeds, at the cost of bf16 feature rounding.
+    """
     n, f = X.shape
     p, b, cb = partitions, per_batch, chunk_batches
     rows_per_chunk = p * b * cb
     for s in range(0, n, rows_per_chunk):
         e = min(s + rows_per_chunk, n)
-        yield stripe_chunk(X[s:e], y[s:e], s + start_row, p, b, cb, shuffle_seed)
+        yield stripe_chunk(
+            X[s:e], y[s:e], s + start_row, p, b, cb, shuffle_seed,
+            feature_dtype=feature_dtype,
+        )
 
 
 def generator_chunks(
@@ -47,6 +56,7 @@ def generator_chunks(
     per_batch: int,
     chunk_batches: int,
     shuffle_seed: int | None = None,
+    feature_dtype=np.float32,
 ) -> Iterator[Batches]:
     """Chunks from a chunk-exact generator ``chunk_fn(start, stop) -> (X, y)``
     (e.g. ``functools.partial(sea_chunk, seed, drift_every=...)`` adapted to
@@ -58,7 +68,9 @@ def generator_chunks(
     for s in range(0, total_rows, rows_per_chunk):
         e = min(s + rows_per_chunk, total_rows)
         X, y = chunk_fn(s, e)
-        yield stripe_chunk(X, y, s, p, b, cb, shuffle_seed)
+        yield stripe_chunk(
+            X, y, s, p, b, cb, shuffle_seed, feature_dtype=feature_dtype
+        )
 
 
 class _Stop:
@@ -128,6 +140,7 @@ def csv_chunks(
     target_column: str = "target",
     shuffle_seed: int | None = None,
     block_bytes: int = 16 << 20,
+    feature_dtype=np.float32,
 ) -> Iterator[Batches]:
     """Stream a CSV file from disk as striped chunks, without materialising it.
 
@@ -177,6 +190,7 @@ def csv_chunks(
                 start,
                 p, b, cb,
                 shuffle_seed,
+                feature_dtype=feature_dtype,
             )
             return chunk, rest
 

@@ -188,6 +188,7 @@ def stripe_chunk(
     per_batch: int,
     nb: int,
     shuffle_seed: int | None = None,
+    feature_dtype=np.float32,
 ) -> Batches:
     """Pad + row-stripe one contiguous span of the stream into ``[P, NB, B]``.
 
@@ -204,12 +205,22 @@ def stripe_chunk(
     exactly once, so a pre-shuffle is semantically identical to the engine's
     in-jit shuffle while costing zero device time. Chunking-invariant
     (counter-based PRNG keyed on the absolute batch slot).
+
+    ``feature_dtype`` is the *transport* dtype of the feature plane
+    (default f32 — bit-exact). ``ml_dtypes.bfloat16`` halves the
+    host→device bytes of every chunk — the lever for transport-bound
+    feeds (the r05 chunked benchmark measured the shared remote-TPU
+    tunnel, not the parser, as that path's bottleneck); the engines
+    compute in f32 either way (``engine/loop`` and ``engine/window`` cast
+    the plane back on device, so every driver — chunked, one-shot, mesh —
+    gets f32 compute), and only the feature rounding to bf16 differs.
+    Labels, rows and masks are integral and stay exact.
     """
     n = len(y)
     p, b = partitions, per_batch
     gmap, rows, valid = _stripe_maps(n, start_row, p, b, nb, shuffle_seed)
     return Batches(
-        X=_pad(np.asarray(X, np.float32), p * nb * b, 0.0)[gmap],
+        X=_pad(np.asarray(X, feature_dtype), p * nb * b, 0.0)[gmap],
         y=_pad(np.asarray(y, np.int32), p * nb * b, 0)[gmap],
         rows=rows,
         valid=valid,

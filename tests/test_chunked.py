@@ -279,3 +279,35 @@ def test_chunked_auto_guard_resolution():
         retrain_error_threshold=0.5,
     )
     assert pinned.retrain_error_threshold == 0.5
+
+
+def test_bf16_transport_plane_runs_and_detects():
+    """The opt-in bf16 feature-transport plane (stripe_chunk feature_dtype):
+    chunks ship bf16, the engine casts back to f32 on device, and the
+    planted boundary is still detected. f32 stays the bit-exact default."""
+    import ml_dtypes
+
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.feeder import chunk_stream_arrays
+    from distributed_drift_detection_tpu.io.synth import planted_prototypes
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    stream = planted_prototypes(0, concepts=4, rows_per_concept=400)
+    model = build_model("centroid", ModelSpec(21, 4))
+
+    def flags_for(dtype):
+        det = ChunkedDetector(model, partitions=4, seed=0, window=1)
+        chunks = list(
+            chunk_stream_arrays(
+                stream.X, stream.y, 4, 25, 4, feature_dtype=dtype
+            )
+        )
+        assert chunks[0].X.dtype == dtype
+        return det.run(iter(chunks))
+
+    f = flags_for(ml_dtypes.bfloat16)
+    det_bf16 = int((np.asarray(f.change_global) >= 0).sum())
+    assert det_bf16 >= 9  # 3 interior boundaries x 4 partitions, allow slack
+    # Default f32 plane: identical pipeline, full precision.
+    f32 = flags_for(np.float32)
+    assert int((np.asarray(f32.change_global) >= 0).sum()) >= 9
