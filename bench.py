@@ -41,7 +41,10 @@ a 1-element d2h fetch because ``block_until_ready`` alone is unreliable
 over this tunnel), and ``phase_s`` (per-repetition upload/detect/collect
 breakdown) — so a tunnel stall is visible *in the artifact*: excess in
 ``upload``/``collect`` (host↔device link) rather than ``detect`` (device
-compute).
+compute). ``compile_s`` records the compile split explicitly (first-call
+warm-up span vs the steady-state median) and ``phase_hist`` the per-phase
+histograms (telemetry metrics registry, Prometheus bucket semantics), so
+BENCH_*.json trajectories separate recompilation from kernel regressions.
 """
 
 import json
@@ -497,9 +500,19 @@ def main() -> None:
     # table pays multi-second one-time setup over the remote-TPU link, and
     # without fetching here it lands in timed repetition 1's collect phase
     # (both r03 captures recorded a 3.5–6.4 s first-rep collect outlier).
+    # Each warm-up is timed individually: warm-up 1 is the first-call span
+    # (jit trace + XLA compile — or persistent-cache load — + one-time
+    # device setup), warm-up 2 the first compile-free call, and together
+    # with the steady-state median below they make the compile split an
+    # explicit artifact field (compile_s) instead of a vanished cost —
+    # BENCH_*.json trajectories can then separate recompilation regressions
+    # from kernel regressions.
+    warmup_times = []
     for _ in range(2):
+        t0 = time.perf_counter()
         db, dk = shard_batches(batches, keys, mesh)
         np.asarray(runner(db, dk).packed)
+        warmup_times.append(time.perf_counter() - t0)
 
     # Timed runs — each spans the reference's Final Time
     # (upload + detect + collect + delay metric). Contention-robust headline
@@ -543,6 +556,22 @@ def main() -> None:
 
     rows_per_sec = stream.num_rows / elapsed
     delay_batches = m.mean_delay_batches
+
+    # Per-phase histograms over the 15 repetitions (telemetry metrics
+    # registry, Prometheus bucket semantics): the artifact carries the
+    # distribution shape, not just the per-rep lists — a bimodal upload
+    # histogram is a stalling tunnel even when the median looks clean.
+    from distributed_drift_detection_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    phase_h = reg.histogram(
+        "phase_seconds", help="Wall-clock seconds by phase over timed reps"
+    )
+    for name, vs in phases.items():
+        for v in vs:
+            phase_h.observe(v, phase=name)
 
     # The 1e9-row sustained soak rides along in the same JSON line (as
     # soak_*-prefixed keys, keeping the one-line contract) so the soak claim
@@ -633,7 +662,19 @@ def main() -> None:
                 "stalled_reps": stalled,  # indices excluded from the median
                 "contended": len(stalled) >= (REPS + 1) // 2,
                 "rep_times_s": [round(t, 4) for t in times],
+                # Compile split (first-rep vs steady-state): warm-up 1 is
+                # the only span containing jit trace + XLA compile;
+                # steady_median_s repeats final_time_s for side-by-side
+                # reading. compile_overhead_s ≈ the compile + one-time-setup
+                # cost a cold process pays once.
+                "compile_s": {
+                    "first_call_s": round(warmup_times[0], 4),
+                    "second_call_s": round(warmup_times[1], 4),
+                    "steady_median_s": round(elapsed, 4),
+                    "compile_overhead_s": round(warmup_times[0] - elapsed, 4),
+                },
                 "phase_s": phases,
+                "phase_hist": reg.to_json(),
                 "rows": stream.num_rows,
                 "partitions": cfg.partitions,
                 # From the resolved config: window=0 (auto) is resolved to a

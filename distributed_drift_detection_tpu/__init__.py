@@ -20,17 +20,33 @@ from .config import (
     RunConfig,
     replace,
 )
-from .ops import (
-    DDMState,
-    DetectorKernel,
-    ddm_batch,
-    ddm_init,
-    ddm_scan,
-    ddm_step,
-    make_detector,
-)
 
 __version__ = "0.1.0"
+
+# The kernel exports pull in jax at module level; resolving them lazily
+# (PEP 562) keeps `import distributed_drift_detection_tpu` jax-free, so the
+# telemetry tooling — `python -m distributed_drift_detection_tpu report`,
+# the exporters — runs wherever the run-log artifact lands, jax installed
+# or not.
+_OPS_EXPORTS = frozenset(
+    {
+        "DDMState",
+        "DetectorKernel",
+        "ddm_batch",
+        "ddm_init",
+        "ddm_scan",
+        "ddm_step",
+        "make_detector",
+    }
+)
+
+
+def __getattr__(name):
+    if name in _OPS_EXPORTS:
+        from . import ops
+
+        return getattr(ops, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def run(cfg, stream=None):

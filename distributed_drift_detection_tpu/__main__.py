@@ -11,28 +11,63 @@ requires editing the script per dataset, ``README.md:12``; quirk #5 fixed):
         jax://local 16 8g 4 "$(date | sed 's/ /_/g')" 512 outdoorStream.csv
 
 With no arguments, runs the module-default config like executing the
-reference script unedited.
+reference script unedited. Two optional flags (anywhere in argv) reach the
+aux subsystems without writing Python: ``--trace-dir DIR`` wraps the detect
+phase in a ``jax.profiler`` trace, ``--telemetry-dir DIR`` persists the
+structured JSONL run log + metric exports (telemetry subsystem).
+
+A second subcommand renders a persisted run log offline (no accelerator,
+no data — just the artifact):
+
+    python -m distributed_drift_detection_tpu report <run.jsonl> [...]
 """
 
 import sys
 
-from .api import run
-from .config import RunConfig
-
-
 _USAGE = (
     "usage: python -m distributed_drift_detection_tpu "
-    "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]"
+    "[--trace-dir DIR] [--telemetry-dir DIR] "
+    "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
+    "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]"
 )
 
 
+def _pop_flag(argv: list[str], flag: str) -> str | None:
+    """Extract ``--flag VALUE`` / ``--flag=VALUE`` from argv (mutating it)."""
+    for i, arg in enumerate(argv):
+        if arg == flag:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{_USAGE}\n({flag} needs a value)")
+            value = argv[i + 1]
+            del argv[i : i + 2]
+            return value
+        if arg.startswith(flag + "="):
+            del argv[i]
+            return arg[len(flag) + 1 :]
+    return None
+
+
 def main(argv: list[str]) -> None:
+    if argv and argv[0] == "report":
+        # jax-free path: the report CLI must work wherever the artifact is.
+        from .telemetry.report import main as report_main
+
+        report_main(argv[1:])
+        return
+
+    argv = list(argv)
     kw = {}
+    trace_dir = _pop_flag(argv, "--trace-dir")
+    if trace_dir is not None:
+        kw["trace_dir"] = trace_dir
+    telemetry_dir = _pop_flag(argv, "--telemetry-dir")
+    if telemetry_dir is not None:
+        kw["telemetry_dir"] = telemetry_dir
     if argv and len(argv) not in (6, 7):
         raise SystemExit(_USAGE)
     if argv:
         try:
-            kw = dict(
+            kw.update(
                 url=argv[0],
                 partitions=int(argv[1]),  # reference INSTANCES
                 memory=argv[2],
@@ -44,6 +79,10 @@ def main(argv: list[str]) -> None:
             raise SystemExit(f"{_USAGE}\n({e})") from None
         if len(argv) == 7:
             kw["dataset"] = argv[6]
+
+    from .api import run  # lazy: `report` above must not initialise jax
+    from .config import RunConfig
+
     res = run(RunConfig(**kw))
     m = res.metrics
     print(
@@ -51,6 +90,8 @@ def main(argv: list[str]) -> None:
         f"mean_delay_rows={m.mean_delay_rows:.1f} "
         f"final_time={res.total_time:.3f}s"
     )
+    if res.telemetry_path:
+        print(f"telemetry={res.telemetry_path}")
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ collection + delay computation — not just the kernel.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import OrderedDict
 from typing import NamedTuple
@@ -77,6 +78,11 @@ class PreparedRun(NamedTuple):
     keys: jax.Array
     mesh: object  # jax.sharding.Mesh | None
     config: RunConfig  # the resolved config (window=0 auto already applied)
+    # Runner provenance for the telemetry compile_completed event: whether
+    # the jitted runner came from the in-process cache and how long the
+    # closure build took (the XLA compile itself is lazy — it lands in the
+    # first detect phase of a fresh configuration).
+    compile_info: "dict | None" = None
 
 
 # Compiled-runner LRU: repeated run()/prepare() calls with the same static
@@ -90,9 +96,12 @@ _RUNNER_CACHE: OrderedDict = OrderedDict()
 def _cached_runner(
     cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool, model
 ):
+    """Returns ``(runner, mesh, compile_info)`` — see PreparedRun.compile_info."""
+
     def build():
         from .ops.detectors import make_detector
 
+        t0 = time.perf_counter()
         mesh = make_mesh(n_dev) if n_dev > 1 else None
         runner = make_mesh_runner(
             model,
@@ -115,7 +124,10 @@ def _cached_runner(
             ),
             rotations=cfg.window_rotations,
         )
-        return runner, mesh
+        return runner, mesh, {
+            "cached": False,
+            "build_seconds": time.perf_counter() - t0,
+        }
 
     if model.host_callback:
         return build()  # never cached: closures pin host-side fitted state
@@ -129,12 +141,13 @@ def _cached_runner(
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)
-        return _RUNNER_CACHE[key]
-    out = build()
-    _RUNNER_CACHE[key] = out
+        runner, mesh = _RUNNER_CACHE[key]
+        return runner, mesh, {"cached": True, "build_seconds": 0.0}
+    runner, mesh, info = build()
+    _RUNNER_CACHE[key] = (runner, mesh)
     if len(_RUNNER_CACHE) > 8:
         _RUNNER_CACHE.popitem(last=False)
-    return out
+    return runner, mesh, info
 
 
 def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
@@ -198,9 +211,9 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # cluster existed).
     while n_dev > 1 and cfg.partitions % n_dev:
         n_dev -= 1
-    runner, mesh = _cached_runner(cfg, spec, n_dev, indexed, model)
+    runner, mesh, compile_info = _cached_runner(cfg, spec, n_dev, indexed, model)
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
-    return PreparedRun(stream, batches, runner, keys, mesh, cfg)
+    return PreparedRun(stream, batches, runner, keys, mesh, cfg, compile_info)
 
 
 class RunResult(NamedTuple):
@@ -211,6 +224,9 @@ class RunResult(NamedTuple):
     timings: dict  # per-phase breakdown (aux subsystem: tracing)
     stream: StreamData
     config: RunConfig
+    # Path of the persisted JSONL run log (telemetry subsystem) — None
+    # unless cfg.telemetry_dir was set.
+    telemetry_path: "str | None" = None
 
 
 def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
@@ -235,68 +251,174 @@ def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
 def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     timer = PhaseTimer()
 
-    with timer.phase("prepare"):
-        prep = prepare(cfg, stream)
-    stream, batches, runner, keys, mesh = (
-        prep.stream, prep.batches, prep.runner, prep.keys, prep.mesh
-    )
-    cfg = prep.config  # window=0 auto already resolved by prepare()
+    # Telemetry (off by default): the event log is opened before the work
+    # and written AFTER the Final Time span closes — nothing below touches
+    # the timed region, and with telemetry_dir unset no telemetry code runs.
+    log = None
+    if cfg.telemetry_dir:
+        from .telemetry.events import EventLog
 
-    # --- the reference's Final Time span starts here (:224) ---
-    start = time.perf_counter()
-    with timer.phase("upload"):
-        dev_batches, dev_keys = shard_batches(batches, keys, mesh)
-    with timer.phase("detect"), maybe_trace(cfg.trace_dir):
-        out = runner(dev_batches, dev_keys)
-        jax.block_until_ready(out)
-    with timer.phase("collect"):
-        # One latency-bound d2h transfer of the packed flag table; the drift
-        # vote is recomputed host-side from it in f32, matching the device
-        # reduction's dtype and arithmetic (sum of exact 0/1 indicators, one
-        # f32 divide).
-        flags = unpack_flags(np.asarray(out.packed))
-        changed = (flags.change_global >= 0).astype(np.float32)
-        vote = changed.sum(axis=0, dtype=np.float32) / np.float32(
-            changed.shape[0]
-        )
-        m = delay_metrics(
-            flags.change_global, stream.dist_between_changes, cfg.per_batch
-        )
-    total_time = time.perf_counter() - start
-    # --- span ends (:260) ---
+        log = EventLog.open_run(cfg.telemetry_dir, name=cfg.resolved_app_name())
 
-    if cfg.validate:
-        from .utils.validate import validate_flag_rows
-
-        from .io.stream import stripe_geometry
-
-        # Expected batch count from the stripe geometry — independent of the
-        # flags table, so the audit can catch a dropped/duplicated boundary.
-        _, nb = stripe_geometry(stream.num_rows, cfg.partitions, cfg.per_batch)
-        validate_flag_rows(flags, nb, cfg.per_batch, stream.num_rows)
-
-    if cfg.results_csv:
-        # Boundary attribution (metrics.attribution_metrics) is computed
-        # OUTSIDE the Final Time span: the reference's timed region ends at
-        # the delay metric (:260) and the quality axes are bookkeeping on
-        # the already-collected flag table, not part of the benchmarked
-        # pipeline. Streams without planted-boundary geometry have no
-        # ground truth to attribute against — their quality cells carry the
-        # placeholder, not an every-detection-is-spurious fabrication.
-        a = (
-            attribution_metrics(
-                flags.change_global,
-                stream.dist_between_changes,
-                stream.num_rows,
+    # try/finally, not context manager: a failed run (bad dataset path, flag
+    # audit rejection, full telemetry volume on the very first emit) must
+    # still release the log's fd — the partial log is the crash evidence
+    # (lines are flushed per emit), but a long-lived process catching
+    # per-run errors must not leak a descriptor per failure.
+    try:
+        if log is not None:
+            log.emit(
+                "run_started",
+                run_id=log.run_id,
+                config={
+                    "dataset": str(cfg.dataset),
+                    "model": cfg.model,
+                    "detector": cfg.detector,
+                    "partitions": cfg.partitions,
+                    "per_batch": cfg.per_batch,
+                    "mult_data": cfg.mult_data,
+                    "seed": cfg.seed,
+                    "backend": cfg.backend,
+                    "window": cfg.window,  # 0 = auto; resolved rides on
+                    "window_rotations": cfg.window_rotations,  # compile event
+                },
             )
-            if stream.dist_between_changes > 0
-            else None
+        with timer.phase("prepare"):
+            prep = prepare(cfg, stream)
+        stream, batches, runner, keys, mesh = (
+            prep.stream, prep.batches, prep.runner, prep.keys, prep.mesh
         )
-        append_result(
-            cfg.results_csv,
-            result_row(cfg, total_time, m, stream.num_rows, attribution=a),
-        )
+        cfg = prep.config  # window=0 auto already resolved by prepare()
 
-    return RunResult(flags, vote, m, total_time, timer.as_dict(), stream, cfg)
+        # --- the reference's Final Time span starts here (:224) ---
+        start = time.perf_counter()
+        with timer.phase("upload"):
+            dev_batches, dev_keys = shard_batches(batches, keys, mesh)
+        with timer.phase("detect"), maybe_trace(cfg.trace_dir):
+            out = runner(dev_batches, dev_keys)
+            jax.block_until_ready(out)
+        with timer.phase("collect"):
+            # One latency-bound d2h transfer of the packed flag table; the
+            # drift vote is recomputed host-side from it in f32, matching
+            # the device reduction's dtype and arithmetic (sum of exact 0/1
+            # indicators, one f32 divide).
+            flags = unpack_flags(np.asarray(out.packed))
+            changed = (flags.change_global >= 0).astype(np.float32)
+            vote = changed.sum(axis=0, dtype=np.float32) / np.float32(
+                changed.shape[0]
+            )
+            m = delay_metrics(
+                flags.change_global, stream.dist_between_changes, cfg.per_batch
+            )
+        total_time = time.perf_counter() - start
+        # --- span ends (:260) ---
+
+        if cfg.validate:
+            from .utils.validate import validate_flag_rows
+
+            from .io.stream import stripe_geometry
+
+            # Expected batch count from the stripe geometry — independent of
+            # the flags table, so the audit can catch a dropped/duplicated
+            # boundary.
+            _, nb = stripe_geometry(
+                stream.num_rows, cfg.partitions, cfg.per_batch
+            )
+            validate_flag_rows(flags, nb, cfg.per_batch, stream.num_rows)
+
+        if cfg.results_csv:
+            # Boundary attribution (metrics.attribution_metrics) is computed
+            # OUTSIDE the Final Time span: the reference's timed region ends
+            # at the delay metric (:260) and the quality axes are bookkeeping
+            # on the already-collected flag table, not part of the benchmarked
+            # pipeline. Streams without planted-boundary geometry have no
+            # ground truth to attribute against — their quality cells carry
+            # the placeholder, not an every-detection-is-spurious fabrication.
+            a = (
+                attribution_metrics(
+                    flags.change_global,
+                    stream.dist_between_changes,
+                    stream.num_rows,
+                )
+                if stream.dist_between_changes > 0
+                else None
+            )
+            append_result(
+                cfg.results_csv,
+                result_row(cfg, total_time, m, stream.num_rows, attribution=a),
+            )
+
+        telemetry_path = None
+        if log is not None:
+            telemetry_path = _finish_telemetry(
+                log, prep, timer, flags, m, stream, total_time
+            )
+    finally:
+        if log is not None:
+            log.close()  # idempotent; _finish_telemetry closes on success
+
+    return RunResult(
+        flags, vote, m, total_time, timer.as_dict(), stream, cfg,
+        telemetry_path,
+    )
+
+
+def _finish_telemetry(
+    log, prep: PreparedRun, timer, flags: FlagRows, m: DelayMetrics,
+    stream: StreamData, total_time: float,
+) -> str:
+    """Persist the run's events + metric exports (after the timed span)."""
+    from .telemetry.events import emit_flag_events
+    from .telemetry.metrics import MetricsRegistry, write_exports
+
+    cfg = prep.config
+    info = prep.compile_info or {"cached": False, "build_seconds": 0.0}
+    log.emit(
+        "compile_completed",
+        cached=info["cached"],
+        seconds=info["build_seconds"],
+        window=cfg.window,  # the resolved execution policy (0=auto applied)
+        window_rotations=cfg.window_rotations,
+    )
+    for name, secs in timer.as_dict().items():
+        log.emit("phase_completed", phase=name, seconds=secs)
+    emit_flag_events(
+        log,
+        flags.change_global,
+        flags.forced_retrain,
+        stream.dist_between_changes,
+    )
+    log.emit(
+        "run_completed",
+        rows=stream.num_rows,
+        seconds=total_time,
+        detections=m.num_detections,
+        rows_per_sec=(
+            stream.num_rows / total_time if total_time > 0 else None
+        ),
+    )
+    log.close()
+
+    reg = MetricsRegistry()
+    det = reg.counter(
+        "detections_total", help="Drift detections by stream partition"
+    )
+    for q, n in enumerate(np.asarray(m.detections_per_partition)):
+        if n:
+            det.inc(int(n), partition=str(q))
+    reg.counter(
+        "rows_processed_total", help="Stream rows through the detection loop"
+    ).inc(stream.num_rows)
+    reg.gauge(
+        "compile_seconds", help="Runner build time (0 on runner-cache hit)"
+    ).set(info["build_seconds"])
+    phase_h = reg.histogram(
+        "phase_seconds", help="Wall-clock seconds by run phase"
+    )
+    for name, secs in timer.as_dict().items():
+        phase_h.observe(secs, phase=name)
+    base, _ = os.path.splitext(log.path)
+    write_exports(reg, base)
+    return log.path
 
 

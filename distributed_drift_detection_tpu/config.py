@@ -369,6 +369,13 @@ class RunConfig:
     # this directory (aux subsystem: tracing/profiling, SURVEY.md §5) —
     # inspect with TensorBoard or Perfetto.
     trace_dir: str = ""
+    # When set, api.run persists a structured JSONL event log for the run
+    # into this directory (one file per run; schema docs/OBSERVABILITY.md)
+    # plus JSON/Prometheus metric exports, summarizable offline with
+    # `python -m distributed_drift_detection_tpu report <run.jsonl>`.
+    # None (default) = off: no telemetry code executes, and every event is
+    # emitted outside the reference-parity Final Time span either way.
+    telemetry_dir: str | None = None
 
     # --- bookkeeping (recorded verbatim into the results CSV, C11 parity) ---
     app_name: str = ""

@@ -190,12 +190,45 @@ class ChunkedDetector:
         self.batches_done += int(chunk.y.shape[1])
         return flags
 
-    def run(self, chunks: Iterator[Batches], progress=None) -> FlagRows:
-        """Drain an iterator of chunks; concatenates flags on host."""
+    def emit_chunk_event(self, telemetry, chunk: int, flags: FlagRows):
+        """Collect one chunk's flags host-side and emit its
+        ``chunk_completed`` progress event; returns ``(collected flags,
+        the chunk's detection count)``.
+
+        Shared by :meth:`run` and feed-level drivers (e.g. the
+        ``examples/unbounded_stream.py`` checkpoint-mid-stream loop) so the
+        event payload — including the detection count — is engine-defined
+        everywhere. The ``np.asarray`` forces the chunk's device→host sync
+        — the opt-in observability trade.
+        """
+        flags = jax.tree.map(np.asarray, flags)
+        detections = int((flags.change_global >= 0).sum())
+        telemetry.emit(
+            "chunk_completed",
+            chunk=chunk,
+            batches_done=self.batches_done,
+            detections=detections,
+        )
+        return flags, detections
+
+    def run(
+        self, chunks: Iterator[Batches], progress=None, telemetry=None
+    ) -> FlagRows:
+        """Drain an iterator of chunks; concatenates flags on host.
+
+        ``telemetry`` (a :class:`..telemetry.events.EventLog`) emits one
+        ``chunk_completed`` progress event per chunk, with the detection
+        count extracted from that chunk's collected flag table. The
+        extraction forces the chunk's device→host sync at chunk granularity
+        — the opt-in observability trade; without telemetry the host copy
+        stays deferred to the final concat and nothing here synchronizes.
+        """
         out = []
         for i, chunk in enumerate(chunks):
             flags = self.feed(chunk)
-            out.append(flags)  # async; host copy deferred to the concat below
+            if telemetry is not None:
+                flags, _ = self.emit_chunk_event(telemetry, i, flags)
+            out.append(flags)  # async unless telemetry collected it above
             if progress is not None:
                 progress(i, self.batches_done)
         host = [jax.tree.map(np.asarray, f) for f in out]

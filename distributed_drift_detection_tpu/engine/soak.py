@@ -637,6 +637,7 @@ def run_soak_chained(
     key=None,
     on_leg=None,
     checkpoint_path: str = "",
+    telemetry=None,
 ) -> ChainedSoakSummary:
     """Host driver over :func:`make_soak_chain`: run ≥ ``total_rows`` rows.
 
@@ -666,6 +667,12 @@ def run_soak_chained(
     checkpoint is written — at-least-once delivery: a crash inside the
     observer re-runs that leg (and re-delivers its flags) on resume. The
     file is removed on successful completion.
+
+    ``telemetry`` (a :class:`..telemetry.events.EventLog`) emits one
+    ``leg_completed`` progress event per leg — extracted from the leg's
+    already-host-converted flag table, so multi-minute chains are visible
+    mid-flight from the persisted log. Same at-least-once semantics as
+    ``on_leg`` (events fire before the leg's checkpoint lands).
     """
     import math
     import os
@@ -790,6 +797,12 @@ def run_soak_chained(
         # inside the measured span.
         if on_leg is not None:
             on_leg(s, out.flags._replace(change_global=cg))
+        if telemetry is not None:
+            # rows counts the leg's full consumption (leg 0's batch_a seed
+            # included), so the legs sum to the summary's rows_processed.
+            telemetry.emit(
+                "leg_completed", leg=s, rows=p * L * b, detections=int(hit.size)
+            )
         if checkpoint_path:
             tmp = checkpoint_path + ".tmp"
             save_checkpoint(

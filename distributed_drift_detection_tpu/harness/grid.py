@@ -184,6 +184,7 @@ def run_grid(
     detectors: list[str] | None = None,
     warmup: bool = False,
     spec: str = "warn",
+    telemetry_dir: str = "",
 ) -> int:
     """Run all missing trials of the sweep; returns number executed.
 
@@ -198,6 +199,12 @@ def run_grid(
     (:func:`off_spec_reason`): ``'warn'`` (default) runs off-spec cells but
     flags each once via ``progress``; ``'skip'`` drops them from the sweep;
     ``'off'`` disables the check entirely.
+
+    ``telemetry_dir`` gives every executed trial its own JSONL run log in
+    that directory (telemetry subsystem) — the filename embeds the cell's
+    config key, so a crashed sweep leaves per-cell evidence of where time
+    went and where drift fired, not just the missing CSV rows. Warm-up
+    runs stay untelemetered (they are unrecorded by design).
     """
     if spec not in ("warn", "skip", "off"):
         raise ValueError(f"spec must be 'warn', 'skip' or 'off', got {spec!r}")
@@ -231,6 +238,8 @@ def run_grid(
         if warmup and static_key != warmed:
             run(replace(cfg, results_csv="", time_string="warmup"))
             warmed = static_key
+        if telemetry_dir:
+            cfg = replace(cfg, telemetry_dir=telemetry_dir)
         res = run(cfg)
         progress(
             f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
@@ -264,6 +273,13 @@ def main(argv=None) -> None:
         "off-spec (dataset, mult, partitions) cells, skip them, or disable "
         "the check",
     )
+    ap.add_argument(
+        "--telemetry-dir",
+        default="",
+        help="per-trial JSONL run logs into this directory (telemetry "
+        "subsystem; summarize with `python -m "
+        "distributed_drift_detection_tpu report <run.jsonl>`)",
+    )
     args = ap.parse_args(argv)
 
     base = RunConfig(
@@ -280,6 +296,7 @@ def main(argv=None) -> None:
         detectors=args.detectors.split(","),
         warmup=args.warmup,
         spec=args.spec,
+        telemetry_dir=args.telemetry_dir,
     )
 
 

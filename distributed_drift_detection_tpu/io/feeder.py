@@ -22,6 +22,21 @@ from ..engine.loop import Batches
 from .stream import stripe_chunk
 
 
+def _ingest_counters(metrics):
+    """(rows, chunks) counters for a feed path; ``(None, None)`` without a
+    registry — callers guard on None so the disabled path costs nothing."""
+    if metrics is None:
+        return None, None
+    return (
+        metrics.counter(
+            "ingest_rows_total", help="Stream rows striped into chunks"
+        ),
+        metrics.counter(
+            "ingest_chunks_total", help="Fixed-shape [P,CB,B] chunks emitted"
+        ),
+    )
+
+
 def chunk_stream_arrays(
     X: np.ndarray,
     y: np.ndarray,
@@ -31,18 +46,25 @@ def chunk_stream_arrays(
     start_row: int = 0,
     shuffle_seed: int | None = None,
     feature_dtype=np.float32,
+    metrics=None,
 ) -> Iterator[Batches]:
     """Chunk an in-memory stream; rows are global positions + start_row.
 
     ``feature_dtype`` is the transport dtype of the feature plane
     (``stripe_chunk``): ``ml_dtypes.bfloat16`` halves host→device bytes
     for transport-bound feeds, at the cost of bf16 feature rounding.
+    ``metrics`` (a :class:`..telemetry.metrics.MetricsRegistry`) counts
+    ``ingest_rows_total`` / ``ingest_chunks_total`` as the feed progresses.
     """
     n, f = X.shape
     p, b, cb = partitions, per_batch, chunk_batches
+    c_rows, c_chunks = _ingest_counters(metrics)
     rows_per_chunk = p * b * cb
     for s in range(0, n, rows_per_chunk):
         e = min(s + rows_per_chunk, n)
+        if c_rows is not None:
+            c_rows.inc(e - s)
+            c_chunks.inc()
         yield stripe_chunk(
             X[s:e], y[s:e], s + start_row, p, b, cb, shuffle_seed,
             feature_dtype=feature_dtype,
@@ -57,17 +79,23 @@ def generator_chunks(
     chunk_batches: int,
     shuffle_seed: int | None = None,
     feature_dtype=np.float32,
+    metrics=None,
 ) -> Iterator[Batches]:
     """Chunks from a chunk-exact generator ``chunk_fn(start, stop) -> (X, y)``
     (e.g. ``functools.partial(sea_chunk, seed, drift_every=...)`` adapted to
     (start, stop)). Generates only one chunk of rows at a time — 1e9-row
-    soaks never materialise the stream.
+    soaks never materialise the stream. ``metrics`` counts ingest progress
+    (see :func:`chunk_stream_arrays`).
     """
     p, b, cb = partitions, per_batch, chunk_batches
+    c_rows, c_chunks = _ingest_counters(metrics)
     rows_per_chunk = p * b * cb
     for s in range(0, total_rows, rows_per_chunk):
         e = min(s + rows_per_chunk, total_rows)
         X, y = chunk_fn(s, e)
+        if c_rows is not None:
+            c_rows.inc(e - s)
+            c_chunks.inc()
         yield stripe_chunk(
             X, y, s, p, b, cb, shuffle_seed, feature_dtype=feature_dtype
         )
@@ -77,7 +105,7 @@ class _Stop:
     pass
 
 
-def prefetch_chunks(chunks: Iterator, depth: int = 2) -> Iterator:
+def prefetch_chunks(chunks: Iterator, depth: int = 2, metrics=None) -> Iterator:
     """Run a chunk iterator in a background thread, ``depth`` chunks ahead.
 
     JAX async dispatch already overlaps *device* compute with the caller's
@@ -92,7 +120,21 @@ def prefetch_chunks(chunks: Iterator, depth: int = 2) -> Iterator:
     returned iterator (break / exception / GC) stops the producer thread
     promptly — its queue puts are timeout-guarded against a cancellation
     event that the consumer sets on close, so no chunks stay pinned.
+
+    ``metrics`` (a :class:`..telemetry.metrics.MetricsRegistry`) records
+    ``prefetch_chunks_total`` (delivered to the consumer) and the
+    ``prefetch_queue_depth`` gauge sampled at each delivery — a depth
+    pinned at 0 means the consumer is feed-bound, at ``depth`` means
+    device-bound (the SURVEY §7 overlap question, answerable per run).
     """
+    c_total = g_depth = None
+    if metrics is not None:
+        c_total = metrics.counter(
+            "prefetch_chunks_total", help="Chunks delivered by the prefetcher"
+        )
+        g_depth = metrics.gauge(
+            "prefetch_queue_depth", help="Prefetch queue depth at delivery"
+        )
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
 
@@ -124,6 +166,9 @@ def prefetch_chunks(chunks: Iterator, depth: int = 2) -> Iterator:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                if c_total is not None:
+                    c_total.inc()
+                    g_depth.set(q.qsize())
                 yield item
         finally:
             stop.set()
@@ -141,6 +186,7 @@ def csv_chunks(
     shuffle_seed: int | None = None,
     block_bytes: int = 16 << 20,
     feature_dtype=np.float32,
+    metrics=None,
 ) -> Iterator[Batches]:
     """Stream a CSV file from disk as striped chunks, without materialising it.
 
@@ -158,8 +204,17 @@ def csv_chunks(
     which a stream cannot afford by design). They parse through float32
     (exact for integers up to 2^24); larger label ids raise rather than
     silently round.
+
+    ``metrics`` counts ``ingest_rows_total`` / ``ingest_chunks_total`` plus
+    ``ingest_bytes_total`` (file bytes parsed) for the disk path.
     """
     p, b, cb = partitions, per_batch, chunk_batches
+    c_rows, c_chunks = _ingest_counters(metrics)
+    c_bytes = (
+        metrics.counter("ingest_bytes_total", help="CSV bytes parsed")
+        if metrics is not None
+        else None
+    )
     rows_per_chunk = p * b * cb
     from .native import parse_block
 
@@ -192,12 +247,17 @@ def csv_chunks(
                 shuffle_seed,
                 feature_dtype=feature_dtype,
             )
+            if c_rows is not None:
+                c_rows.inc(len(take))
+                c_chunks.inc()
             return chunk, rest
 
         while True:
             block = fh.read(block_bytes)
             if not block:
                 break
+            if c_bytes is not None:
+                c_bytes.inc(len(block))
             block = carry + block
             cut = block.rfind(b"\n")
             if cut < 0:

@@ -12,9 +12,16 @@ metric).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any
 
 import numpy as np
+
+# Placeholder for results-CSV cells with nothing to attribute against —
+# runs without planted-boundary geometry have no ground truth for the
+# Hits/Spurious/Recall quality axes (the reference's own CSV uses "-" for
+# Spark knobs with no meaning in a given mode, config.py's `memory`).
+NO_ATTRIBUTION = "-"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,9 +178,7 @@ def result_row(
 ) -> list:
     """One results-CSV row. ``attribution`` is optional so callers without
     planted-boundary geometry still record the reference columns; absent, the
-    quality cells carry the CSV placeholder."""
-    import os
-
+    quality cells carry :data:`NO_ATTRIBUTION`."""
     return [
         cfg.resolved_app_name(),
         cfg.time_string,
@@ -191,7 +196,7 @@ def result_row(
         metrics.num_detections,
         cfg.model,
         cfg.detector,
-        attribution.hits if attribution else "-",
-        attribution.spurious if attribution else "-",
-        attribution.recall if attribution else "-",
+        attribution.hits if attribution else NO_ATTRIBUTION,
+        attribution.spurious if attribution else NO_ATTRIBUTION,
+        attribution.recall if attribution else NO_ATTRIBUTION,
     ]

@@ -1,0 +1,54 @@
+"""Run telemetry (aux subsystem: observability, SURVEY.md §5).
+
+The reference's only instrumentation is one wall-clock span
+(``DDM_Process.py:224,260``); answering "what did this run do, where did
+the time go, and when/where did drift fire" requires re-running it. This
+subsystem persists that answer as artifacts instead:
+
+* :mod:`.events` — typed, timestamped records (``run_started``,
+  ``phase_completed``, ``drift_detected``, …) appended to a JSONL run log
+  with a versioned schema (``docs/OBSERVABILITY.md``).
+* :mod:`.metrics` — a counters/gauges/histograms registry with JSON and
+  Prometheus-text exporters.
+* :mod:`.spans` — nested wall-clock spans with call counts and a
+  first-call-vs-steady-state split; ``utils.timing.PhaseTimer`` is now a
+  thin compatibility shim over it.
+* :mod:`.report` — ``python -m distributed_drift_detection_tpu report
+  <run.jsonl>``: phase breakdown, throughput, drift timeline,
+  per-partition detection counts from a persisted run log.
+
+Telemetry is **off by default** (``RunConfig.telemetry_dir=None``): every
+hook is an ``if log is not None`` guard outside the timed span, so the
+disabled path executes no telemetry code at all. This package never
+imports jax — the report CLI and the exporters work anywhere.
+"""
+
+from .events import (
+    EVENT_SCHEMA,
+    SCHEMA_VERSION,
+    EventLog,
+    SchemaError,
+    emit_flag_events,
+    read_events,
+    validate_event,
+)
+from .metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    write_exports,
+)
+from .spans import SpanTracker
+
+__all__ = [
+    "EVENT_SCHEMA",
+    "SCHEMA_VERSION",
+    "EventLog",
+    "SchemaError",
+    "emit_flag_events",
+    "read_events",
+    "validate_event",
+    "MetricsRegistry",
+    "parse_prometheus_text",
+    "write_exports",
+    "SpanTracker",
+]

@@ -1,0 +1,223 @@
+"""Structured run events: typed records over an append-only JSONL sink.
+
+One run = one ``*.jsonl`` file; one line = one event. Every event carries
+the envelope ``{"v": SCHEMA_VERSION, "type": ..., "ts": <unix seconds>,
+"seq": <per-log counter>}`` plus its type's required payload fields
+(:data:`EVENT_SCHEMA`). Unknown types and missing required fields are
+rejected at **both** ends — :meth:`EventLog.emit` refuses to write them and
+:func:`read_events` refuses to parse them — so a run log that loads is a
+run log the ``report`` CLI can render. Extra payload fields are allowed
+(forward compatibility); required ones may be ``None`` only where the
+schema note says so.
+
+Engines never emit from inside jitted code: drift/retrain events are
+extracted host-side from the already-collected flag tables
+(:func:`emit_flag_events`), after the timed span closes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+# type -> required payload fields (beyond the v/type/ts/seq envelope).
+# Nullable-by-contract: drift_detected.delay_rows is None for streams
+# without planted-boundary geometry (no ground truth to measure against).
+EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
+    # one per run log, first event: identity + the requested configuration
+    "run_started": ("run_id", "config"),
+    # runner construction: ``cached`` = served from the in-process runner
+    # cache; ``seconds`` = closure/jit build time. The XLA compile itself is
+    # lazy and lands in the first ``detect`` phase_completed of a fresh
+    # config (bench.py's compile_s split measures it explicitly).
+    "compile_completed": ("cached", "seconds"),
+    # one per PhaseTimer/SpanTracker phase (prepare/upload/detect/collect)
+    "phase_completed": ("phase", "seconds"),
+    # one per detector change flag: where drift fired
+    "drift_detected": ("partition", "global_pos", "delay_rows"),
+    # one per model rotate/refit; ``forced`` = saturation-guard fallback
+    # (RunConfig.retrain_error_threshold), not a detector change
+    "retrain": ("partition", "batch", "forced"),
+    # streaming progress: one per ChunkedDetector chunk
+    "chunk_completed": ("chunk", "batches_done", "detections"),
+    # soak progress: one per chained-soak leg (engine.soak.run_soak_chained)
+    "leg_completed": ("leg", "rows", "detections"),
+    # one per run log, last event: totals over the reference's Final Time
+    "run_completed": ("rows", "seconds", "detections"),
+}
+
+
+class SchemaError(ValueError):
+    """An event violates the run-log schema (unknown type, missing field,
+    wrong envelope version, or a line that is not a JSON object)."""
+
+
+# The only required fields allowed to be null (see the schema notes above).
+_NULLABLE = frozenset({("drift_detected", "delay_rows")})
+
+
+def validate_event(event: object) -> dict:
+    """Validate one event dict against :data:`EVENT_SCHEMA`; returns it."""
+    if not isinstance(event, dict):
+        raise SchemaError(f"event is not a JSON object: {event!r:.80}")
+    etype = event.get("type")
+    if etype not in EVENT_SCHEMA:
+        raise SchemaError(
+            f"unknown event type {etype!r}; expected one of "
+            f"{sorted(EVENT_SCHEMA)}"
+        )
+    if event.get("v") != SCHEMA_VERSION:
+        raise SchemaError(
+            f"schema version {event.get('v')!r} != {SCHEMA_VERSION} "
+            f"(event {etype!r})"
+        )
+    for field in ("ts", "seq"):
+        if field not in event:
+            raise SchemaError(f"event {etype!r} missing envelope {field!r}")
+    missing = [f for f in EVENT_SCHEMA[etype] if f not in event]
+    if missing:
+        raise SchemaError(f"event {etype!r} missing required {missing}")
+    # Presence is not enough: a null where the report does arithmetic
+    # (int(done["rows"]), timeline positions) would turn "a log that loads
+    # is a log the report can render" into a downstream TypeError.
+    null = [
+        f
+        for f in EVENT_SCHEMA[etype]
+        if event[f] is None and (etype, f) not in _NULLABLE
+    ]
+    if null:
+        raise SchemaError(f"event {etype!r} has null required {null}")
+    return event
+
+
+_RUN_COUNTER = 0
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+class EventLog:
+    """Append-only JSONL event sink for one run.
+
+    Lines are flushed as written (the log survives a crash mid-run — that
+    is half its point), and every emitted event is schema-validated first,
+    so a malformed emit fails the *producer* loudly instead of poisoning
+    the artifact.
+    """
+
+    def __init__(self, path: str, *, clock=time.time):
+        self.path = path
+        self.run_id = os.path.splitext(os.path.basename(path))[0]
+        self._clock = clock
+        self._seq = 0
+        self._fh = open(path, "a")
+
+    @classmethod
+    def open_run(cls, telemetry_dir: str, name: str = "") -> "EventLog":
+        """Create the directory and a fresh per-run log file inside it.
+
+        ``name`` (e.g. the resolved app name — the grid harness's per-cell
+        config key) is sanitized into the filename; a timestamp + pid +
+        process-local counter suffix keeps concurrent and repeated runs
+        from colliding.
+        """
+        global _RUN_COUNTER
+        os.makedirs(telemetry_dir, exist_ok=True)
+        stem = _SAFE_NAME.sub("_", name).strip("_") or "run"
+        _RUN_COUNTER += 1
+        fname = (
+            f"{stem}-{time.strftime('%Y%m%d-%H%M%S')}"
+            f"-{os.getpid()}-{_RUN_COUNTER}.jsonl"
+        )
+        return cls(os.path.join(telemetry_dir, fname))
+
+    def emit(self, etype: str, **fields) -> dict:
+        """Validate and append one event; returns the full record."""
+        event = {
+            "v": SCHEMA_VERSION,
+            "type": etype,
+            "ts": self._clock(),
+            "seq": self._seq,
+            **fields,
+        }
+        validate_event(event)
+        self._fh.write(json.dumps(event) + "\n")
+        self._fh.flush()
+        self._seq += 1
+        return event
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse and schema-validate a run log; raises :class:`SchemaError` on
+    any malformed line (the CI smoke gate's contract: a log that loads is a
+    log the report can render)."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{path}:{lineno}: not JSON ({e})") from None
+            try:
+                validate_event(event)
+            except SchemaError as e:
+                raise SchemaError(f"{path}:{lineno}: {e}") from None
+            events.append(event)
+    return events
+
+
+def emit_flag_events(
+    log: EventLog,
+    change_global: np.ndarray,
+    forced_retrain: np.ndarray,
+    dist_between_changes: int = 0,
+) -> int:
+    """Emit drift/retrain events from a collected ``[P, NB-1]`` flag table.
+
+    Called host-side on the already-transferred numpy flags, after the
+    timed span — never from jitted code. Every detector change becomes a
+    ``drift_detected`` (with its delay when the stream has planted-boundary
+    geometry, else ``delay_rows=None``); every model rotation — change- or
+    saturation-guard-triggered — becomes a ``retrain`` (``batch`` is the
+    1-based processed-batch index, matching the flag table's column + 1).
+    Returns the number of drift events emitted.
+    """
+    cg = np.asarray(change_global)
+    fr = np.asarray(forced_retrain)
+    dist = int(dist_between_changes)
+    changed = cg >= 0
+    # Column-major (batch-then-partition) order: the log reads as a timeline.
+    for b, p in zip(*np.nonzero(changed.T)):
+        pos = int(cg[p, b])
+        log.emit(
+            "drift_detected",
+            partition=int(p),
+            global_pos=pos,
+            delay_rows=(pos % dist) if dist > 0 else None,
+            batch=int(b) + 1,
+        )
+    for b, p in zip(*np.nonzero((changed | fr).T)):
+        log.emit(
+            "retrain",
+            partition=int(p),
+            batch=int(b) + 1,
+            forced=bool(fr[p, b]),
+        )
+    return int(changed.sum())

@@ -1,0 +1,188 @@
+"""Run-log report: render a persisted JSONL event log as a summary.
+
+    python -m distributed_drift_detection_tpu report <run.jsonl>
+
+Answers the post-hoc questions the reference needs a re-run for: where the
+time went (phase breakdown), how fast it ran (throughput), when and where
+drift fired (ascii timeline over the stream + per-partition counts), and —
+for streaming/soak logs — per-chunk/per-leg progress. Pure stdlib + the
+schema module; no jax, so it runs anywhere the artifact lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .events import read_events
+
+_TIMELINE_BINS = 50
+_TIMELINE_GLYPHS = " .:-=+*#%@"
+
+
+def summarize(events: list[dict]) -> dict:
+    """Fold a validated event list into one summary dict (the report's data
+    model; rendered by :func:`render_report`, reusable programmatically)."""
+    s: dict = {
+        "run_id": None,
+        "config": {},
+        "phases": {},
+        "compile": None,
+        "drifts": [],
+        "retrains": 0,
+        "forced_retrains": 0,
+        "chunks": [],
+        "legs": [],
+        "completed": None,
+    }
+    for e in events:
+        t = e["type"]
+        if t == "run_started":
+            s["run_id"] = e["run_id"]
+            s["config"] = e.get("config") or {}
+        elif t == "phase_completed":
+            s["phases"][e["phase"]] = (
+                s["phases"].get(e["phase"], 0.0) + e["seconds"]
+            )
+        elif t == "compile_completed":
+            s["compile"] = e
+        elif t == "drift_detected":
+            s["drifts"].append(e)
+        elif t == "retrain":
+            s["retrains"] += 1
+            s["forced_retrains"] += bool(e["forced"])
+        elif t == "chunk_completed":
+            s["chunks"].append(e)
+        elif t == "leg_completed":
+            s["legs"].append(e)
+        elif t == "run_completed":
+            s["completed"] = e
+    return s
+
+
+def _timeline(positions: list[int], rows: int, bins: int = _TIMELINE_BINS) -> str:
+    """Ascii density sparkline of drift positions over the stream."""
+    counts = [0] * bins
+    span = max(rows, max(positions) + 1)
+    for pos in positions:
+        counts[min(pos * bins // span, bins - 1)] += 1
+    peak = max(counts)
+    if peak == 0:
+        return "|" + " " * bins + "|"
+    levels = len(_TIMELINE_GLYPHS) - 1
+    body = "".join(
+        _TIMELINE_GLYPHS[(c * levels + peak - 1) // peak] if c else " "
+        for c in counts
+    )
+    return f"|{body}|  (peak {peak}/bin)"
+
+
+def render_report(events: list[dict]) -> str:
+    s = summarize(events)
+    cfg = s["config"]
+    out = []
+    out.append(f"run        {s['run_id'] or '<no run_started event>'}")
+    if cfg:
+        out.append(
+            f"config     dataset={cfg.get('dataset')}  model={cfg.get('model')}"
+            f"  detector={cfg.get('detector')}"
+        )
+        out.append(
+            f"           partitions={cfg.get('partitions')}"
+            f"  per_batch={cfg.get('per_batch')}"
+            f"  mult_data={cfg.get('mult_data')}  seed={cfg.get('seed')}"
+        )
+
+    done = s["completed"]
+    rows = int(done["rows"]) if done else 0
+    if s["phases"]:
+        total = sum(s["phases"].values())
+        out.append("phases")
+        for name, secs in sorted(
+            s["phases"].items(), key=lambda kv: -kv[1]
+        ):
+            pct = 100.0 * secs / total if total > 0 else 0.0
+            out.append(f"  {name:<12} {secs:9.4f} s  {pct:5.1f}%")
+    if s["compile"] is not None:
+        c = s["compile"]
+        out.append(
+            f"compile    build {c['seconds']:.4f} s"
+            f"  (runner cache {'hit' if c['cached'] else 'miss'})"
+        )
+    if done:
+        rps = done.get("rows_per_sec") or (
+            rows / done["seconds"] if done["seconds"] > 0 else float("nan")
+        )
+        out.append(
+            f"throughput {rps:,.0f} rows/s  "
+            f"({rows:,} rows / {done['seconds']:.4f} s Final Time)"
+        )
+    else:
+        out.append("throughput <run incomplete: no run_completed event>")
+
+    drifts = s["drifts"]
+    n_det = done["detections"] if done else len(drifts)
+    out.append(f"detections {n_det}")
+    if drifts:
+        positions = [int(d["global_pos"]) for d in drifts]
+        out.append("drift timeline (stream position, left→right)")
+        out.append("  " + _timeline(positions, rows))
+        delays = [
+            d["delay_rows"] for d in drifts if d["delay_rows"] is not None
+        ]
+        if delays:
+            mean = sum(delays) / len(delays)
+            out.append(
+                f"  delay mean {mean:.1f} rows"
+                f"  min {min(delays)}  max {max(delays)}"
+            )
+        per_part: dict[int, int] = {}
+        for d in drifts:
+            per_part[int(d["partition"])] = (
+                per_part.get(int(d["partition"]), 0) + 1
+            )
+        out.append("per-partition detections")
+        parts = sorted(per_part)
+        for i in range(0, len(parts), 8):
+            out.append(
+                "  "
+                + "  ".join(f"p{q}:{per_part[q]}" for q in parts[i : i + 8])
+            )
+    if s["retrains"]:
+        out.append(
+            f"retrains   {s['retrains']}  ({s['forced_retrains']} forced "
+            "by the saturation guard)"
+        )
+    if s["chunks"]:
+        last = s["chunks"][-1]
+        det = sum(int(c["detections"] or 0) for c in s["chunks"])
+        out.append(
+            f"chunks     {len(s['chunks'])} processed, "
+            f"{last['batches_done']} batches, {det} detections"
+        )
+    if s["legs"]:
+        leg_rows = sum(int(leg["rows"]) for leg in s["legs"])
+        det = sum(int(leg["detections"]) for leg in s["legs"])
+        out.append(
+            f"legs       {len(s['legs'])} completed, {leg_rows:,} rows, "
+            f"{det} detections"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu report",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("run_log", nargs="+", help="run-log *.jsonl path(s)")
+    args = ap.parse_args(argv)
+    for i, path in enumerate(args.run_log):
+        if i:
+            print()
+        print(render_report(read_events(path)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -4,28 +4,31 @@ The reference's only instrumentation is one wall-clock span
 (``DDM_Process.py:224,260``). Here every run gets a per-phase breakdown
 (load/stripe/build/upload/detect/collect) plus an optional ``jax.profiler``
 trace for TPU work.
+
+``PhaseTimer`` is now a **compatibility shim** over
+:class:`..telemetry.spans.SpanTracker` — same ``phase(name)`` context
+manager, same cumulative ``as_dict()`` contract — with the tracker's
+extras (nesting, call counts, first-call-vs-steady-state split via
+``stats()``) available on the same object. New code should use
+``SpanTracker`` directly.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
+
+from ..telemetry.spans import SpanTracker
 
 
-class PhaseTimer:
-    def __init__(self):
-        self.phases: dict[str, float] = {}
+class PhaseTimer(SpanTracker):
+    """``SpanTracker`` under the historical name/API: ``phase`` aliases
+    ``span`` and the mutable ``phases`` attribute is a read view."""
 
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
+    phase = SpanTracker.span
 
-    def as_dict(self) -> dict:
-        return dict(self.phases)
+    @property
+    def phases(self) -> dict[str, float]:
+        return self.as_dict()
 
 
 @contextlib.contextmanager

@@ -5,6 +5,11 @@ Equivalent of executing the reference's ``DDM_Process.py`` once
 ``JAX_PLATFORMS=cpu``).
 
     python examples/quickstart.py [dataset.csv] [mult] [partitions]
+
+Set ``DDD_TELEMETRY_DIR=<dir>`` to persist the structured JSONL run log +
+metric exports (telemetry subsystem; the CI smoke gate drives exactly
+this), then summarize it offline with
+``python -m distributed_drift_detection_tpu report <run.jsonl>``.
 """
 
 import os
@@ -30,6 +35,7 @@ def main():
         model="centroid",
         results_csv="ddm_cluster_runs.csv",  # C11 schema, appended per run
         validate=True,  # host-side flag-table audit after the run
+        telemetry_dir=os.environ.get("DDD_TELEMETRY_DIR") or None,
     )
     res = run(cfg)
     m = res.metrics
@@ -40,6 +46,8 @@ def main():
     print(f"Final Time      {res.total_time:.3f} s  "
           f"({res.stream.num_rows / res.total_time:,.0f} rows/s)")
     print(f"phase breakdown {res.timings}")
+    if res.telemetry_path:
+        print(f"telemetry       {res.telemetry_path}")
 
 
 if __name__ == "__main__":

@@ -12,6 +12,11 @@ to watch it resume at the first unfinished leg.
 
 On a TPU chip, `python bench.py --soak 3e9` runs the measured benchmark
 configuration of the same path (55 M rows/s, every planted boundary found).
+
+Set ``DDD_TELEMETRY_DIR=<dir>`` to persist a JSONL run log with one
+``leg_completed`` event per chained leg — mid-flight progress for
+multi-minute soaks, readable while the chain is still running
+(``python -m distributed_drift_detection_tpu report <run.jsonl>``).
 """
 
 import os
@@ -40,11 +45,32 @@ def main():
         print(f"resuming from {ckpt}")
 
     legs_this_run = []
+    found_this_run = []
 
     def report(leg, flags):
         legs_this_run.append(leg)
         found = int((np.asarray(flags.change_global) >= 0).sum())
+        found_this_run.append(found)
         print(f"  leg {leg}: {found} detections")
+
+    log = None
+    if os.environ.get("DDD_TELEMETRY_DIR"):
+        from distributed_drift_detection_tpu.telemetry.events import EventLog
+
+        log = EventLog.open_run(os.environ["DDD_TELEMETRY_DIR"], name="soak_chain")
+        log.emit(
+            "run_started",
+            run_id=log.run_id,
+            config={
+                "dataset": f"soak:drift_every={drift_every}",
+                "model": "centroid",
+                "detector": "ddm",
+                "partitions": p,
+                "per_batch": b,
+                "total_rows": total,
+            },
+        )
+        print(f"telemetry -> {log.path}")
 
     s = run_soak_chained(
         build_model("centroid", ModelSpec(8, 8)),
@@ -55,10 +81,22 @@ def main():
         max_leg_rows=2**27,  # small legs so interruptions are visible
         checkpoint_path=ckpt,
         on_leg=report,
+        telemetry=log,
     )
     # Throughput over the rows THIS process executed: after a resume,
     # exec_time_s covers only the resumed legs, not the checkpointed ones.
     rows_this_run = s.rows_processed // s.legs * len(legs_this_run)
+    if log is not None:
+        # This-run totals only: exec_time_s covers the resumed legs, so
+        # rows/detections must too, or the report's throughput inflates
+        # after a resume — and they match the log's leg_completed sums.
+        log.emit(
+            "run_completed",
+            rows=rows_this_run,
+            seconds=s.exec_time_s,
+            detections=sum(found_this_run),
+        )
+        log.close()
     rate = (
         f"≈ {rows_this_run / s.exec_time_s / 1e6:.1f}M rows/s"
         if rows_this_run

@@ -5,6 +5,11 @@ window execution across chunk boundaries), checkpoints mid-stream, and
 resumes from the checkpoint — the carry is a few KB per partition.
 
     python examples/unbounded_stream.py [total_rows]
+
+Set ``DDD_TELEMETRY_DIR=<dir>`` to persist a JSONL run log with one
+``chunk_completed`` progress event per chunk plus the feeder's ingest /
+prefetch metric exports (``python -m distributed_drift_detection_tpu
+report <run.jsonl>`` summarizes the log).
 """
 
 import os
@@ -13,6 +18,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo checkout
 
 import tempfile
+import time
 
 import numpy as np
 
@@ -26,6 +32,32 @@ def main():
     total = int(float(sys.argv[1])) if len(sys.argv) > 1 else 2_000_000
     p, b, cb = 8, 1000, 50
 
+    log = reg = None
+    if os.environ.get("DDD_TELEMETRY_DIR"):
+        from distributed_drift_detection_tpu.telemetry.events import EventLog
+        from distributed_drift_detection_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
+        log = EventLog.open_run(
+            os.environ["DDD_TELEMETRY_DIR"], name="unbounded_stream"
+        )
+        log.emit(
+            "run_started",
+            run_id=log.run_id,
+            config={
+                "dataset": "synth:sea,drift_every=100000",
+                "model": "centroid",
+                "detector": "ddm",
+                "partitions": p,
+                "per_batch": b,
+                "chunk_batches": cb,
+                "total_rows": total,
+            },
+        )
+        reg = MetricsRegistry()
+        print(f"telemetry -> {log.path}")
+
     det = ChunkedDetector(
         build_model("centroid", ModelSpec(3, 2)),
         partitions=p,
@@ -35,13 +67,19 @@ def main():
         generator_chunks(
             lambda s, e: sea_chunk(seed=0, start=s, stop=e, drift_every=100_000),
             total_rows=total, partitions=p, per_batch=b, chunk_batches=cb,
-        )
+            metrics=reg,
+        ),
+        metrics=reg,
     )
 
     half = total // (p * b * cb) // 2
-    fed = 0
+    fed = detections = 0
+    t0 = time.perf_counter()
     for i, chunk in enumerate(chunks):
-        det.feed(chunk)
+        flags = det.feed(chunk)
+        if log is not None:
+            _, found = det.emit_chunk_event(log, i, flags)
+            detections += found
         fed += 1
         if i + 1 == half:
             with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
@@ -54,6 +92,19 @@ def main():
             det.restore(path, example_chunk=chunk)
             print("resumed from checkpoint")
     print(f"fed {fed} chunks ({det.batches_done} batches/partition)")
+    if log is not None:
+        from distributed_drift_detection_tpu.telemetry.metrics import (
+            write_exports,
+        )
+
+        log.emit(
+            "run_completed",
+            rows=total,
+            seconds=time.perf_counter() - t0,
+            detections=detections,
+        )
+        log.close()
+        write_exports(reg, os.path.splitext(log.path)[0])
 
 
 if __name__ == "__main__":

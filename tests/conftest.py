@@ -22,7 +22,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the XLA_FLAGS
+    # fallback above already forces the 8 virtual host devices there.
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,497 @@
+"""Telemetry subsystem: event schema, metrics registry, spans, report CLI,
+and the api/engine wiring (ISSUE 1 acceptance: JSONL round-trip, exporter
+golden output, report smoke over real and synthetic run logs)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu import RunConfig, replace, run
+from distributed_drift_detection_tpu.telemetry import (
+    EVENT_SCHEMA,
+    SCHEMA_VERSION,
+    EventLog,
+    MetricsRegistry,
+    SchemaError,
+    SpanTracker,
+    parse_prometheus_text,
+    read_events,
+)
+from distributed_drift_detection_tpu.telemetry.report import render_report
+
+
+# ---------------------------------------------------------------------------
+# Events: JSONL schema round-trip
+# ---------------------------------------------------------------------------
+
+# One representative payload per event type — every type must serialize and
+# re-parse (the schema round-trip acceptance criterion).
+EXAMPLE_EVENTS = {
+    "run_started": dict(run_id="r1", config={"dataset": "x.csv"}),
+    "compile_completed": dict(cached=False, seconds=0.25),
+    "phase_completed": dict(phase="detect", seconds=1.5),
+    "drift_detected": dict(partition=3, global_pos=1234, delay_rows=34),
+    "retrain": dict(partition=0, batch=7, forced=True),
+    "chunk_completed": dict(chunk=2, batches_done=256, detections=4),
+    "leg_completed": dict(leg=1, rows=100_000, detections=9),
+    "run_completed": dict(rows=2_048_000, seconds=0.16, detections=600),
+}
+
+
+def test_every_event_type_round_trips(tmp_path):
+    assert set(EXAMPLE_EVENTS) == set(EVENT_SCHEMA)
+    path = str(tmp_path / "run.jsonl")
+    with EventLog(path) as log:
+        for etype, payload in EXAMPLE_EVENTS.items():
+            log.emit(etype, **payload)
+    events = read_events(path)
+    assert [e["type"] for e in events] == list(EXAMPLE_EVENTS)
+    for e, (etype, payload) in zip(events, EXAMPLE_EVENTS.items()):
+        assert e["v"] == SCHEMA_VERSION
+        assert isinstance(e["ts"], float) and isinstance(e["seq"], int)
+        for k, v in payload.items():
+            assert e[k] == v
+    assert [e["seq"] for e in events] == list(range(len(EXAMPLE_EVENTS)))
+
+
+def test_nullable_delay_and_extra_fields(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with EventLog(path) as log:
+        log.emit(
+            "drift_detected", partition=0, global_pos=5, delay_rows=None,
+            batch=1,  # extra payload fields are allowed (forward compat)
+        )
+    (e,) = read_events(path)
+    assert e["delay_rows"] is None and e["batch"] == 1
+
+
+def test_emit_rejects_unknown_type_and_missing_fields(tmp_path):
+    log = EventLog(str(tmp_path / "run.jsonl"))
+    with pytest.raises(SchemaError, match="unknown event type"):
+        log.emit("drift_suspected", partition=0)
+    with pytest.raises(SchemaError, match="missing required"):
+        log.emit("drift_detected", partition=0)  # no global_pos/delay_rows
+    log.close()
+    assert read_events(log.path) == []  # nothing malformed was written
+
+
+def test_null_required_fields_rejected(tmp_path):
+    # delay_rows is the one documented-nullable required field; a null
+    # anywhere else (e.g. run_completed.rows) would crash the report's
+    # arithmetic, so both emit and read refuse it.
+    log = EventLog(str(tmp_path / "run.jsonl"))
+    with pytest.raises(SchemaError, match="null required"):
+        log.emit("run_completed", rows=None, seconds=1.0, detections=0)
+    log.close()
+    with open(log.path, "w") as fh:
+        fh.write(
+            json.dumps(
+                {
+                    "v": SCHEMA_VERSION, "type": "drift_detected", "ts": 0.0,
+                    "seq": 0, "partition": None, "global_pos": 5,
+                    "delay_rows": None,
+                }
+            )
+            + "\n"
+        )
+    with pytest.raises(SchemaError, match="null required"):
+        read_events(log.path)
+
+
+def test_read_rejects_malformed_lines(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    good = {
+        "v": SCHEMA_VERSION, "type": "phase_completed", "ts": 0.0, "seq": 0,
+        "phase": "detect", "seconds": 1.0,
+    }
+    for bad, match in [
+        ({**good, "type": "nope"}, "unknown event type"),
+        ({**good, "v": 99}, "schema version"),
+        ({k: v for k, v in good.items() if k != "seconds"}, "missing required"),
+        ({k: v for k, v in good.items() if k != "ts"}, "envelope"),
+    ]:
+        with open(path, "w") as fh:
+            fh.write(json.dumps(bad) + "\n")
+        with pytest.raises(SchemaError):
+            read_events(path)
+        with pytest.raises(SchemaError, match=match):
+            read_events(path)
+    with open(path, "w") as fh:
+        fh.write("not json\n")
+    with pytest.raises(SchemaError, match="not JSON"):
+        read_events(path)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("rows_processed_total", help="rows")
+    c.inc()
+    c.inc(41)
+    c.inc(2, partition="3")
+    assert c.values[()] == 42
+    assert c.values[(("partition", "3"),)] == 2
+    with pytest.raises(ValueError, match=">= 0"):
+        c.inc(-1)
+    # idempotent re-fetch; kind clash fails loudly
+    assert reg.counter("rows_processed_total") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("rows_processed_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name!")
+
+
+def test_gauge_and_histogram_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("compile_seconds")
+    g.set(1.5)
+    g.set(0.25)  # last write wins
+    assert g.values[()] == 0.25
+
+    h = reg.histogram("phase_seconds", buckets=(0.5, 2.0))
+    for v in (0.25, 0.5, 4.0):
+        h.observe(v, phase="detect")
+    key = (("phase", "detect"),)
+    counts, total, n = h.values[key]
+    assert counts == [2, 0, 1]  # raw per-bucket (+overflow)
+    assert total == 4.75 and n == 3
+    # cumulative export semantics: +Inf == count
+    assert h.cumulative(key) == [("0.5", 2), ("2", 2), ("+Inf", 3)]
+    with pytest.raises(ValueError, match="sorted"):
+        reg.histogram("bad_buckets", buckets=(2.0, 0.5))
+
+
+PROM_GOLDEN = """\
+# HELP compile_seconds h
+# TYPE compile_seconds gauge
+compile_seconds 0.25
+# HELP detections_total Drift detections
+# TYPE detections_total counter
+detections_total{partition="0"} 3
+detections_total{partition="1"} 1
+# HELP phase_seconds Phase seconds
+# TYPE phase_seconds histogram
+phase_seconds_bucket{phase="detect",le="0.5"} 2
+phase_seconds_bucket{phase="detect",le="2"} 2
+phase_seconds_bucket{phase="detect",le="+Inf"} 3
+phase_seconds_sum{phase="detect"} 4.75
+phase_seconds_count{phase="detect"} 3
+"""
+
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    c = reg.counter("detections_total", help="Drift detections")
+    c.inc(3, partition="0")
+    c.inc(partition="1")
+    reg.gauge("compile_seconds", help="h").set(0.25)
+    h = reg.histogram("phase_seconds", help="Phase seconds", buckets=(0.5, 2.0))
+    for v in (0.25, 0.5, 4.0):
+        h.observe(v, phase="detect")
+    return reg
+
+
+def test_prometheus_text_golden():
+    assert _golden_registry().to_prometheus_text() == PROM_GOLDEN
+
+
+def test_prometheus_text_round_trips():
+    samples = parse_prometheus_text(PROM_GOLDEN)
+    assert samples[("detections_total", (("partition", "0"),))] == 3
+    assert samples[("compile_seconds", ())] == 0.25
+    assert (
+        samples[("phase_seconds_bucket", (("phase", "detect"), ("le", "+Inf")))]
+        == 3
+    )
+    assert samples[("phase_seconds_sum", (("phase", "detect"),))] == 4.75
+    # count consistency: +Inf bucket == _count (Prometheus invariant)
+    assert (
+        samples[("phase_seconds_count", (("phase", "detect"),))]
+        == samples[
+            ("phase_seconds_bucket", (("phase", "detect"), ("le", "+Inf")))
+        ]
+    )
+
+
+def test_prometheus_escape_round_trip():
+    # Label values with backslashes/quotes/newlines must survive the
+    # export→parse round trip (a sequential-replace unescape corrupts
+    # 'C:\new': the literal backslash's escape pairs with the 'n').
+    reg = MetricsRegistry()
+    tricky = 'C:\\new\nline "q"'
+    reg.counter("files_total").inc(1, path=tricky)
+    samples = parse_prometheus_text(reg.to_prometheus_text())
+    assert samples[("files_total", (("path", tricky),))] == 1
+
+
+def test_json_export_matches_prom():
+    j = _golden_registry().to_json()
+    assert j["detections_total"]["kind"] == "counter"
+    assert j["detections_total"]["samples"] == [
+        {"labels": {"partition": "0"}, "value": 3},
+        {"labels": {"partition": "1"}, "value": 1},
+    ]
+    hist = j["phase_seconds"]["samples"][0]
+    assert hist["count"] == 3 and hist["sum"] == 4.75
+    assert hist["buckets"] == {"0.5": 2, "2": 2, "+Inf": 3}
+
+
+# ---------------------------------------------------------------------------
+# Spans + PhaseTimer shim
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_counts_and_first_call_split():
+    tr = SpanTracker()
+    for _ in range(3):
+        with tr.span("leg"):
+            with tr.span("detect"):
+                pass
+    stats = tr.stats()
+    assert set(stats) == {"leg", "leg/detect"}
+    assert stats["leg"]["count"] == 3
+    assert stats["leg/detect"]["count"] == 3
+    s = stats["leg"]
+    assert s["total_s"] >= s["first_s"] >= 0
+    assert s["steady_total_s"] == pytest.approx(s["total_s"] - s["first_s"])
+    assert s["steady_mean_s"] == pytest.approx(s["steady_total_s"] / 2)
+    split = tr.compile_split("leg/detect")
+    assert split["calls"] == 3 and split["first_call_s"] >= 0
+    assert tr.compile_split("nope") is None
+    # as_dict is the flat PhaseTimer contract
+    assert set(tr.as_dict()) == {"leg", "leg/detect"}
+
+
+def test_phase_timer_shim_keeps_contract():
+    from distributed_drift_detection_tpu.utils.timing import PhaseTimer
+
+    t = PhaseTimer()
+    with t.phase("detect"):
+        pass
+    with t.phase("detect"):
+        pass
+    assert set(t.phases) == {"detect"}
+    assert t.as_dict()["detect"] == t.phases["detect"] > 0
+    assert t.stats()["detect"]["count"] == 2  # tracker extras ride along
+
+
+# ---------------------------------------------------------------------------
+# Report CLI
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_run_log(tmp_path) -> str:
+    log = EventLog.open_run(str(tmp_path), name="synthetic")
+    log.emit(
+        "run_started",
+        run_id=log.run_id,
+        config={
+            "dataset": "x.csv", "model": "centroid", "detector": "ddm",
+            "partitions": 2, "per_batch": 50, "mult_data": 1.0, "seed": 0,
+        },
+    )
+    log.emit("compile_completed", cached=True, seconds=0.0)
+    for phase, secs in [("prepare", 0.2), ("detect", 1.0), ("collect", 0.05)]:
+        log.emit("phase_completed", phase=phase, seconds=secs)
+    for p, pos in [(0, 1010), (1, 1025), (0, 2040)]:
+        log.emit(
+            "drift_detected", partition=p, global_pos=pos,
+            delay_rows=pos % 1000,
+        )
+        log.emit("retrain", partition=p, batch=pos // 100, forced=False)
+    log.emit(
+        "run_completed", rows=3000, seconds=1.25, detections=3,
+        rows_per_sec=2400.0,
+    )
+    log.close()
+    return log.path
+
+
+def test_report_renders_synthetic_log(tmp_path):
+    out = render_report(read_events(_synthetic_run_log(tmp_path)))
+    assert "model=centroid" in out
+    assert "detect" in out and "phases" in out
+    assert "2,400 rows/s" in out
+    assert "drift timeline" in out
+    assert "p0:2" in out and "p1:1" in out
+    assert "delay mean 25.0 rows" in out
+    assert "retrains   3" in out
+
+
+def test_report_cli_smoke(tmp_path, capsys):
+    from distributed_drift_detection_tpu.__main__ import main as cli_main
+
+    path = _synthetic_run_log(tmp_path)
+    cli_main(["report", path])
+    out = capsys.readouterr().out
+    assert "throughput" in out and "2,400 rows/s" in out
+
+
+def test_report_incomplete_log(tmp_path):
+    """A crashed run's partial log still renders (that is half the point)."""
+    log = EventLog.open_run(str(tmp_path), name="crashed")
+    log.emit("run_started", run_id=log.run_id, config={"model": "gnb"})
+    log.emit("phase_completed", phase="prepare", seconds=0.5)
+    log.close()
+    out = render_report(read_events(log.path))
+    assert "run incomplete" in out
+
+
+def test_main_flag_parsing():
+    from distributed_drift_detection_tpu.__main__ import _pop_flag
+
+    argv = ["--telemetry-dir", "/tmp/t", "jax://local"]
+    assert _pop_flag(argv, "--telemetry-dir") == "/tmp/t"
+    assert argv == ["jax://local"]
+    argv = ["--trace-dir=/tmp/tr"]
+    assert _pop_flag(argv, "--trace-dir") == "/tmp/tr"
+    assert argv == []
+    assert _pop_flag(["x"], "--trace-dir") is None
+    with pytest.raises(SystemExit):
+        _pop_flag(["--trace-dir"], "--trace-dir")
+
+
+# ---------------------------------------------------------------------------
+# api / engine wiring (real runs, CPU backend)
+# ---------------------------------------------------------------------------
+
+
+def test_api_run_emits_validating_log_and_exports(tmp_path):
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0", mult_data=1, partitions=4,
+        per_batch=50, model="centroid", results_csv="",
+        telemetry_dir=str(tmp_path / "tele"),
+    )
+    res = run(cfg)
+    assert res.telemetry_path and os.path.exists(res.telemetry_path)
+
+    events = read_events(res.telemetry_path)  # schema-validates every line
+    types = [e["type"] for e in events]
+    assert types[0] == "run_started" and types[-1] == "run_completed"
+    assert {"compile_completed", "phase_completed"} <= set(types)
+
+    drifts = [e for e in events if e["type"] == "drift_detected"]
+    assert len(drifts) == res.metrics.num_detections
+    per_part = np.zeros(cfg.partitions, int)
+    for d in drifts:
+        per_part[d["partition"]] += 1
+        assert d["delay_rows"] == d["global_pos"] % res.stream.dist_between_changes
+    np.testing.assert_array_equal(
+        per_part, np.asarray(res.metrics.detections_per_partition)
+    )
+
+    done = events[-1]
+    assert done["rows"] == res.stream.num_rows
+    assert done["detections"] == res.metrics.num_detections
+    phases = {
+        e["phase"]: e["seconds"]
+        for e in events
+        if e["type"] == "phase_completed"
+    }
+    assert set(phases) == {"prepare", "upload", "detect", "collect"}
+
+    # metric exports next to the log; prom text round-trips and agrees
+    base = os.path.splitext(res.telemetry_path)[0]
+    samples = parse_prometheus_text(open(base + ".prom").read())
+    det_total = sum(
+        v for (name, _), v in samples.items() if name == "detections_total"
+    )
+    assert det_total == res.metrics.num_detections
+    assert samples[("rows_processed_total", ())] == res.stream.num_rows
+    with open(base + ".metrics.json") as fh:
+        assert json.load(fh)["rows_processed_total"]["kind"] == "counter"
+
+    # the report renders the real artifact
+    out = render_report(events)
+    assert "throughput" in out and "per-partition detections" in out
+
+
+def test_api_telemetry_disabled_by_default(tmp_path):
+    assert RunConfig().telemetry_dir is None
+    res = run(
+        RunConfig(
+            dataset="synth:rialto,seed=0", mult_data=1, partitions=4,
+            per_batch=50, model="centroid", results_csv="",
+        )
+    )
+    assert res.telemetry_path is None
+
+
+def test_chunked_detector_emits_chunk_events(tmp_path):
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.feeder import chunk_stream_arrays
+    from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    X, y = rialto_like_xy(seed=0)
+    p, b, cb = 2, 50, 8
+    model = build_model("centroid", ModelSpec(X.shape[1], int(y.max()) + 1))
+
+    def detect(telemetry):
+        det = ChunkedDetector(model, partitions=p, seed=0)
+        return det.run(
+            chunk_stream_arrays(X, y, p, b, cb), telemetry=telemetry
+        )
+
+    plain = detect(None)
+    log = EventLog.open_run(str(tmp_path), name="chunked")
+    with log:
+        flags = detect(log)
+    # telemetry's per-chunk sync must not change results
+    np.testing.assert_array_equal(
+        np.asarray(plain.change_global), np.asarray(flags.change_global)
+    )
+    events = read_events(log.path)
+    assert all(e["type"] == "chunk_completed" for e in events)
+    n_chunks = -(-len(y) // (p * b * cb))
+    assert [e["chunk"] for e in events] == list(range(n_chunks))
+    assert sum(e["detections"] for e in events) == int(
+        (np.asarray(flags.change_global) >= 0).sum()
+    )
+    assert events[-1]["batches_done"] == int(
+        np.asarray(flags.change_global).shape[1]
+    )
+
+
+def test_soak_chained_emits_leg_events(tmp_path):
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    model = build_model("centroid", ModelSpec(8, 8))
+    log = EventLog.open_run(str(tmp_path), name="soak")
+    with log:
+        s = run_soak_chained(
+            model, partitions=2, per_batch=50, total_rows=4000,
+            drift_every=500, max_leg_rows=2000, telemetry=log,
+        )
+    events = read_events(log.path)
+    assert [e["type"] for e in events] == ["leg_completed"] * s.legs
+    assert s.legs >= 2  # max_leg_rows forced a real chain
+    assert sum(e["rows"] for e in events) == s.rows_processed
+    assert sum(e["detections"] for e in events) == s.detections
+
+
+def test_feeder_ingest_counters():
+    from distributed_drift_detection_tpu.io.feeder import (
+        chunk_stream_arrays,
+        prefetch_chunks,
+    )
+
+    n, f = 1000, 3
+    X = np.zeros((n, f), np.float32)
+    y = np.zeros(n, np.int32)
+    reg = MetricsRegistry()
+    chunks = list(
+        prefetch_chunks(
+            chunk_stream_arrays(X, y, 2, 10, 8, metrics=reg), metrics=reg
+        )
+    )
+    assert reg.counter("ingest_rows_total").values[()] == n
+    assert reg.counter("ingest_chunks_total").values[()] == len(chunks)
+    assert reg.counter("prefetch_chunks_total").values[()] == len(chunks)
