@@ -11,24 +11,33 @@ requires editing the script per dataset, ``README.md:12``; quirk #5 fixed):
         jax://local 16 8g 4 "$(date | sed 's/ /_/g')" 512 outdoorStream.csv
 
 With no arguments, runs the module-default config like executing the
-reference script unedited. Two optional flags (anywhere in argv) reach the
-aux subsystems without writing Python: ``--trace-dir DIR`` wraps the detect
-phase in a ``jax.profiler`` trace, ``--telemetry-dir DIR`` persists the
-structured JSONL run log + metric exports (telemetry subsystem).
+reference script unedited. Three optional flags (anywhere in argv) reach
+the aux subsystems without writing Python: ``--trace-dir DIR`` wraps the
+detect phase in a ``jax.profiler`` trace, ``--profile-dir DIR`` wraps the
+whole Final Time span in one (TensorBoard/Perfetto-readable, next to the
+run's telemetry artifacts; mutually exclusive with ``--trace-dir``), and
+``--telemetry-dir DIR`` persists the structured JSONL run log + metric
+exports (telemetry subsystem).
 
-A second subcommand renders a persisted run log offline (no accelerator,
-no data — just the artifact):
+Two further subcommands work offline (no accelerator, no data — just the
+artifacts):
 
     python -m distributed_drift_detection_tpu report <run.jsonl> [...]
+    python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
+
+``report`` renders a persisted run log; ``perf`` diffs bench artifacts
+across rounds per cell and exits nonzero on gated regressions beyond a
+tolerance (telemetry.perf).
 """
 
 import sys
 
 _USAGE = (
     "usage: python -m distributed_drift_detection_tpu "
-    "[--trace-dir DIR] [--telemetry-dir DIR] "
+    "[--trace-dir DIR] [--profile-dir DIR] [--telemetry-dir DIR] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
-    "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]"
+    "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
+    "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]"
 )
 
 
@@ -54,12 +63,21 @@ def main(argv: list[str]) -> None:
 
         report_main(argv[1:])
         return
+    if argv and argv[0] == "perf":
+        # jax-free path too: bench artifacts are diffed wherever they land.
+        from .telemetry.perf import main as perf_main
+
+        perf_main(argv[1:])
+        return
 
     argv = list(argv)
     kw = {}
     trace_dir = _pop_flag(argv, "--trace-dir")
     if trace_dir is not None:
         kw["trace_dir"] = trace_dir
+    profile_dir = _pop_flag(argv, "--profile-dir")
+    if profile_dir is not None:
+        kw["profile_dir"] = profile_dir
     telemetry_dir = _pop_flag(argv, "--telemetry-dir")
     if telemetry_dir is not None:
         kw["telemetry_dir"] = telemetry_dir

@@ -251,6 +251,13 @@ def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
 def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     timer = PhaseTimer()
 
+    if cfg.profile_dir and cfg.trace_dir:
+        raise ValueError(
+            "profile_dir and trace_dir are mutually exclusive (jax rejects "
+            "nested profiler sessions): profile_dir captures the whole "
+            "Final Time span, trace_dir only the detect phase — pick one"
+        )
+
     # Telemetry (off by default): the event log is opened before the work
     # and written AFTER the Final Time span closes — nothing below touches
     # the timed region, and with telemetry_dir unset no telemetry code runs.
@@ -290,27 +297,46 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
         )
         cfg = prep.config  # window=0 auto already resolved by prepare()
 
+        # Device-memory snapshot BEFORE the detect phase (telemetry.profile)
+        # — taken here, between prepare and the span open, so it is outside
+        # the reference-parity timed region; None where the backend reports
+        # nothing (XLA CPU). Gated on the log: with telemetry off no
+        # profile code runs at all.
+        pre_mem = None
+        if log is not None:
+            from .telemetry.profile import device_memory_stats
+
+            pre_mem = device_memory_stats()
+
         # --- the reference's Final Time span starts here (:224) ---
-        start = time.perf_counter()
-        with timer.phase("upload"):
-            dev_batches, dev_keys = shard_batches(batches, keys, mesh)
-        with timer.phase("detect"), maybe_trace(cfg.trace_dir):
-            out = runner(dev_batches, dev_keys)
-            jax.block_until_ready(out)
-        with timer.phase("collect"):
-            # One latency-bound d2h transfer of the packed flag table; the
-            # drift vote is recomputed host-side from it in f32, matching
-            # the device reduction's dtype and arithmetic (sum of exact 0/1
-            # indicators, one f32 divide).
-            flags = unpack_flags(np.asarray(out.packed))
-            changed = (flags.change_global >= 0).astype(np.float32)
-            vote = changed.sum(axis=0, dtype=np.float32) / np.float32(
-                changed.shape[0]
-            )
-            m = delay_metrics(
-                flags.change_global, stream.dist_between_changes, cfg.per_batch
-            )
-        total_time = time.perf_counter() - start
+        # cfg.profile_dir (opt-in) wraps the WHOLE span in a jax.profiler
+        # capture; the session opens before `start` and closes after
+        # total_time is taken, so its start/stop overhead stays outside
+        # the measured region (the in-span capture overhead is the point
+        # of profiling and is documented as perturbing).
+        with maybe_trace(cfg.profile_dir):
+            start = time.perf_counter()
+            with timer.phase("upload"):
+                dev_batches, dev_keys = shard_batches(batches, keys, mesh)
+            with timer.phase("detect"), maybe_trace(cfg.trace_dir):
+                out = runner(dev_batches, dev_keys)
+                jax.block_until_ready(out)
+            with timer.phase("collect"):
+                # One latency-bound d2h transfer of the packed flag table;
+                # the drift vote is recomputed host-side from it in f32,
+                # matching the device reduction's dtype and arithmetic (sum
+                # of exact 0/1 indicators, one f32 divide).
+                flags = unpack_flags(np.asarray(out.packed))
+                changed = (flags.change_global >= 0).astype(np.float32)
+                vote = changed.sum(axis=0, dtype=np.float32) / np.float32(
+                    changed.shape[0]
+                )
+                m = delay_metrics(
+                    flags.change_global,
+                    stream.dist_between_changes,
+                    cfg.per_batch,
+                )
+            total_time = time.perf_counter() - start
         # --- span ends (:260) ---
 
         if cfg.validate:
@@ -351,7 +377,11 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
         telemetry_path = None
         if log is not None:
             telemetry_path = _finish_telemetry(
-                log, prep, timer, flags, m, stream, total_time
+                log, prep, timer, flags, m, stream, total_time, pre_mem,
+                # The committed (mesh-sharded) arrays the runner actually
+                # executed with: lowering with these analyzes the SAME
+                # program the span ran, not a default-placement twin.
+                runner_args=(dev_batches, dev_keys),
             )
     finally:
         if log is not None:
@@ -365,9 +395,22 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
 
 def _finish_telemetry(
     log, prep: PreparedRun, timer, flags: FlagRows, m: DelayMetrics,
-    stream: StreamData, total_time: float,
+    stream: StreamData, total_time: float, pre_mem: "dict | None" = None,
+    runner_args: "tuple | None" = None,
 ) -> str:
-    """Persist the run's events + metric exports (after the timed span)."""
+    """Persist the run's events + metric exports (after the timed span).
+
+    This is the ONLY place the compiler/device introspection
+    (telemetry.profile) runs from inside a run — strictly after the Final
+    Time span closed (the purity test pins this via the caller graph).
+    ``runner_args`` are the committed device arrays the runner executed
+    with, so the analyzed program is the executed one (sharding included).
+    The real cost is re-lowering + AOT-compiling the runner for
+    ``cost_analysis``/``memory_analysis`` — roughly one extra compile per
+    telemetered run unless a persistent compile cache is enabled (bench.py
+    enables one; api.run does not) — the opt-in observability trade.
+    """
+    from .telemetry import profile as _profile
     from .telemetry.events import emit_flag_events
     from .telemetry.metrics import MetricsRegistry, write_exports
 
@@ -382,6 +425,15 @@ def _finish_telemetry(
     )
     for name, secs in timer.as_dict().items():
         log.emit("phase_completed", phase=name, seconds=secs)
+    # Compiler introspection of the runner that just executed, at the
+    # arguments it executed with (falling back to the host pytrees — same
+    # avals, default placement — for callers without the device arrays).
+    args = runner_args if runner_args is not None else (prep.batches, prep.keys)
+    xla_stats = _profile.compiled_stats(prep.runner, *args)
+    _profile.emit_compiled_events(log, xla_stats, where="detect_runner")
+    post_mem = _profile.device_memory_stats()
+    _profile.emit_device_memory_event(log, pre_mem, when="before_detect")
+    _profile.emit_device_memory_event(log, post_mem, when="after_detect")
     emit_flag_events(
         log,
         flags.change_global,
@@ -417,6 +469,9 @@ def _finish_telemetry(
     )
     for name, secs in timer.as_dict().items():
         phase_h.observe(secs, phase=name)
+    _profile.record_compiled_gauges(reg, xla_stats)
+    _profile.record_device_memory_gauges(reg, pre_mem, when="before_detect")
+    _profile.record_device_memory_gauges(reg, post_mem, when="after_detect")
     base, _ = os.path.splitext(log.path)
     write_exports(reg, base)
     return log.path

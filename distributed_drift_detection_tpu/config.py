@@ -369,6 +369,16 @@ class RunConfig:
     # this directory (aux subsystem: tracing/profiling, SURVEY.md §5) —
     # inspect with TensorBoard or Perfetto.
     trace_dir: str = ""
+    # When set, wrap the ENTIRE Final Time span (upload + detect + collect)
+    # in a jax.profiler trace written to this directory, so the
+    # TensorBoard/Perfetto-readable capture lands next to the run's
+    # telemetry artifacts (CLI: --profile-dir). Profiling inevitably
+    # perturbs what it measures — the capture rides *inside* the timed
+    # span by design (that is the span being profiled); treat the run's
+    # Final Time as diagnostic, not a headline. Mutually exclusive with
+    # trace_dir (jax rejects nested profiler sessions; api.run fails
+    # loudly before starting work).
+    profile_dir: str = ""
     # When set, api.run persists a structured JSONL event log for the run
     # into this directory (one file per run; schema docs/OBSERVABILITY.md)
     # plus JSON/Prometheus metric exports, summarizable offline with

@@ -190,7 +190,23 @@ class ChunkedDetector:
         self.batches_done += int(chunk.y.shape[1])
         return flags
 
-    def emit_chunk_event(self, telemetry, chunk: int, flags: FlagRows):
+    @staticmethod
+    def record_memory_gauges(metrics, when: str = "chunk") -> None:
+        """Record a device-memory snapshot into a metrics registry
+        (``device_bytes_in_use{when=...}`` latest point +
+        ``device_peak_bytes_in_use`` max across calls — telemetry.profile).
+        Cheap host call, no device sync; a no-op where the backend reports
+        nothing (XLA CPU)."""
+        from ..telemetry.profile import (
+            device_memory_stats,
+            record_device_memory_gauges,
+        )
+
+        record_device_memory_gauges(metrics, device_memory_stats(), when=when)
+
+    def emit_chunk_event(
+        self, telemetry, chunk: int, flags: FlagRows, metrics=None
+    ):
         """Collect one chunk's flags host-side and emit its
         ``chunk_completed`` progress event; returns ``(collected flags,
         the chunk's detection count)``.
@@ -199,7 +215,9 @@ class ChunkedDetector:
         ``examples/unbounded_stream.py`` checkpoint-mid-stream loop) so the
         event payload — including the detection count — is engine-defined
         everywhere. The ``np.asarray`` forces the chunk's device→host sync
-        — the opt-in observability trade.
+        — the opt-in observability trade. ``metrics`` (a
+        :class:`..telemetry.metrics.MetricsRegistry`) additionally records
+        the per-chunk device-memory gauges.
         """
         flags = jax.tree.map(np.asarray, flags)
         detections = int((flags.change_global >= 0).sum())
@@ -209,10 +227,16 @@ class ChunkedDetector:
             batches_done=self.batches_done,
             detections=detections,
         )
+        if metrics is not None:
+            self.record_memory_gauges(metrics)
         return flags, detections
 
     def run(
-        self, chunks: Iterator[Batches], progress=None, telemetry=None
+        self,
+        chunks: Iterator[Batches],
+        progress=None,
+        telemetry=None,
+        metrics=None,
     ) -> FlagRows:
         """Drain an iterator of chunks; concatenates flags on host.
 
@@ -222,12 +246,16 @@ class ChunkedDetector:
         extraction forces the chunk's device→host sync at chunk granularity
         — the opt-in observability trade; without telemetry the host copy
         stays deferred to the final concat and nothing here synchronizes.
+        ``metrics`` records the per-chunk device-memory gauges (no sync —
+        usable with or without the event log).
         """
         out = []
         for i, chunk in enumerate(chunks):
             flags = self.feed(chunk)
             if telemetry is not None:
-                flags, _ = self.emit_chunk_event(telemetry, i, flags)
+                flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
+            elif metrics is not None:
+                self.record_memory_gauges(metrics)
             out.append(flags)  # async unless telemetry collected it above
             if progress is not None:
                 progress(i, self.batches_done)

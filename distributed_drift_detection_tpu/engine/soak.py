@@ -638,6 +638,7 @@ def run_soak_chained(
     on_leg=None,
     checkpoint_path: str = "",
     telemetry=None,
+    metrics=None,
 ) -> ChainedSoakSummary:
     """Host driver over :func:`make_soak_chain`: run ≥ ``total_rows`` rows.
 
@@ -673,6 +674,15 @@ def run_soak_chained(
     already-host-converted flag table, so multi-minute chains are visible
     mid-flight from the persisted log. Same at-least-once semantics as
     ``on_leg`` (events fire before the leg's checkpoint lands).
+
+    ``metrics`` (a :class:`..telemetry.metrics.MetricsRegistry`) records a
+    per-leg device-memory snapshot (``device_bytes_in_use{when="leg"}``
+    latest point + ``device_peak_bytes_in_use`` max across legs —
+    telemetry.profile): a chain whose HBM footprint creeps leg over leg is
+    visible in the export, not just at the OOM. Cheap host call, no device
+    sync, no-op where the backend reports nothing; it does run inside
+    ``exec_time_s`` (the driver's own per-leg d2h already syncs there) —
+    the same opt-in observability trade as ``telemetry``.
     """
     import math
     import os
@@ -802,6 +812,15 @@ def run_soak_chained(
             # included), so the legs sum to the summary's rows_processed.
             telemetry.emit(
                 "leg_completed", leg=s, rows=p * L * b, detections=int(hit.size)
+            )
+        if metrics is not None:
+            from ..telemetry.profile import (
+                device_memory_stats,
+                record_device_memory_gauges,
+            )
+
+            record_device_memory_gauges(
+                metrics, device_memory_stats(), when="leg"
             )
         if checkpoint_path:
             tmp = checkpoint_path + ".tmp"

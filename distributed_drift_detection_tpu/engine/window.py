@@ -148,8 +148,12 @@ def make_window_span(
             batches = batches._replace(X=batches.X.astype(jnp.float32))
         if indexed:
             # Compressed stream: slice index planes, gather X/y from the
-            # (replicated, cache-resident) row table on device.
+            # (replicated, cache-resident) row table on device. The row
+            # table honors the same transport-dtype seam as the dense
+            # branch above: engines compute in f32 for every plane layout.
             base_X = batches.base_X
+            if base_X.dtype != jnp.float32:
+                base_X = base_X.astype(jnp.float32)
             base_y = batches.base_y
             r_idx = pad_tail(batches.idx, 0)  # [NBF+W, B]
             mat_X = lambda i: base_X[i.astype(jnp.int32)]  # noqa: E731
@@ -389,7 +393,11 @@ def make_window_runner(
         indexed = isinstance(batches, IndexedBatches)
         key, k_init = jax.random.split(key)
         if indexed:
-            a_X = batches.base_X[batches.idx[0].astype(jnp.int32)]
+            # f32 like the span's gathers — batch_a must not smuggle a
+            # narrower transport dtype into the first fit.
+            a_X = batches.base_X[batches.idx[0].astype(jnp.int32)].astype(
+                jnp.float32
+            )
             a_y = batches.base_y[batches.idx[0].astype(jnp.int32)]
         else:
             a_X, a_y = batches.X[0], batches.y[0]

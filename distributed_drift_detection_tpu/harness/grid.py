@@ -185,6 +185,7 @@ def run_grid(
     warmup: bool = False,
     spec: str = "warn",
     telemetry_dir: str = "",
+    profile_dir: str = "",
 ) -> int:
     """Run all missing trials of the sweep; returns number executed.
 
@@ -205,6 +206,13 @@ def run_grid(
     config key, so a crashed sweep leaves per-cell evidence of where time
     went and where drift fired, not just the missing CSV rows. Warm-up
     runs stay untelemetered (they are unrecorded by design).
+
+    ``profile_dir`` wraps every executed trial's Final Time span in a
+    ``jax.profiler`` capture under that directory (one timestamped
+    session subdirectory per trial — ``RunConfig.profile_dir``). Profiling
+    perturbs the very Final Times the grid records, so use it on
+    diagnostic sweeps, not the 5-trial result grids. Warm-ups stay
+    unprofiled, like telemetry.
     """
     if spec not in ("warn", "skip", "off"):
         raise ValueError(f"spec must be 'warn', 'skip' or 'off', got {spec!r}")
@@ -240,6 +248,8 @@ def run_grid(
             warmed = static_key
         if telemetry_dir:
             cfg = replace(cfg, telemetry_dir=telemetry_dir)
+        if profile_dir:
+            cfg = replace(cfg, profile_dir=profile_dir)
         res = run(cfg)
         progress(
             f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
@@ -280,6 +290,13 @@ def main(argv=None) -> None:
         "subsystem; summarize with `python -m "
         "distributed_drift_detection_tpu report <run.jsonl>`)",
     )
+    ap.add_argument(
+        "--profile-dir",
+        default="",
+        help="wrap each trial's Final Time span in a jax.profiler capture "
+        "under this directory (perturbs the recorded Final Times — "
+        "diagnostic sweeps only; see run_grid)",
+    )
     args = ap.parse_args(argv)
 
     base = RunConfig(
@@ -297,6 +314,7 @@ def main(argv=None) -> None:
         warmup=args.warmup,
         spec=args.spec,
         telemetry_dir=args.telemetry_dir,
+        profile_dir=args.profile_dir,
     )
 
 

@@ -40,7 +40,9 @@ rather than of the paper:
   ε_cut is ``p(1−p)`` with ``p = window mean`` — bucket variances
   (the paper's within-bucket Welford terms) need not be tracked at all.
   Feeding non-indicator reals would silently mis-scale ε_cut; the scalar
-  spec documents the contract. Because errors are integral, every sum
+  spec documents the contract, and the opt-in debug guard
+  (``DDD_DEBUG_INDICATORS=1`` or :func:`set_debug_indicator_checks`)
+  enforces it with a host assert at every kernel entry. Because errors are integral, every sum
   (bucket, pending chunk, window total) is carried in **int32**, exact up
   to the validated int32 window capacity — a float32 total would round
   away +1 increments past 2²⁴ (~16.7 M) accumulated errors on long
@@ -65,6 +67,7 @@ two-level minima test), and the classic implementations report none —
 from __future__ import annotations
 
 import math
+import os
 from typing import NamedTuple
 
 import jax
@@ -73,6 +76,66 @@ from jax import lax
 
 from ..config import ADWINParams
 from .ddm import DDMBatchResult, DDMWindowResult, summarise_batch, summarise_window
+
+# --- opt-in indicator debug guard (advisor round-5 finding) ----------------
+#
+# The Bernoulli-input contract (module docstring) is otherwise enforced
+# only by documentation: a caller feeding real-valued errors (e.g. raw
+# losses instead of 0/1 indicators) has them silently truncated toward 0
+# by the exact-int32 casts below, corrupting the window mean with no
+# error. With the guard on, every public kernel entry inserts a host
+# callback that asserts the (valid) inputs are exact 0/1 and fails the
+# device program loudly (XlaRuntimeError wrapping the ValueError) instead.
+# Opt-in because the callback is a host round-trip per traced call site —
+# debug tool, not production path. Enable via the DDD_DEBUG_INDICATORS
+# env var or set_debug_indicator_checks(True); takes effect at TRACE
+# time, so already-jitted executables are unaffected until re-traced.
+
+_DEBUG_ENV = "DDD_DEBUG_INDICATORS"
+_debug_indicators: bool | None = None  # None = defer to the env var
+
+
+def set_debug_indicator_checks(enabled: bool | None) -> None:
+    """Force the 0/1-indicator guard on/off; ``None`` defers to the
+    ``DDD_DEBUG_INDICATORS`` env var (the default)."""
+    global _debug_indicators
+    _debug_indicators = enabled
+
+
+def _indicator_checks_enabled() -> bool:
+    if _debug_indicators is not None:
+        return _debug_indicators
+    # Conventional boolean env semantics: "0"/"false"/"off"/"no"/"" all
+    # mean off — a user exporting DDD_DEBUG_INDICATORS=0 to disable must
+    # not get the guard's host round-trip enabled.
+    return os.environ.get(_DEBUG_ENV, "").strip().lower() not in (
+        "", "0", "false", "off", "no",
+    )
+
+
+def _host_assert_indicator(errs, valid) -> None:
+    import numpy as np
+
+    e, v = np.asarray(errs), np.asarray(valid, bool)
+    bad = v & ~((e == 0) | (e == 1))
+    if bad.any():
+        vals = np.unique(e[bad])[:5]
+        raise ValueError(
+            f"ADWIN received non-indicator error values {vals.tolist()} — "
+            "the kernel's exact-int32 sums require 0/1 indicators (module "
+            "docstring); real-valued errors would be silently truncated "
+            "to 0 by the int32 cast"
+        )
+
+
+def _maybe_check_indicator(errs, valid=None) -> None:
+    """Insert the host assert when the guard is enabled (trace-time gate:
+    a static no-op — same compiled graph — when off)."""
+    if not _indicator_checks_enabled():
+        return
+    if valid is None:
+        valid = jnp.ones(jnp.shape(errs), bool)
+    jax.debug.callback(_host_assert_indicator, errs, valid)
 
 
 class ADWINState(NamedTuple):
@@ -264,6 +327,7 @@ def adwin_step(
     False; ``change`` can only be True at chunk-completing elements.
     """
     _validate_adwin(params)
+    _maybe_check_indicator(err)
     t = state.t + 1
     ps = state.pend_sum + err.astype(jnp.int32)
     flush = t % params.clock == 0
@@ -293,6 +357,7 @@ def _adwin_masks(
     iterations over :func:`_flush_chunk` (dead slots are the identity),
     ``clock``× shorter than the element scan it replaces."""
     _validate_adwin(params)
+    _maybe_check_indicator(errs, valid)
     clock = int(params.clock)
     n_el = errs.shape[0]
     nc = n_el // clock + 1  # ≥ chunks any (carry, valid-pattern) can finish

@@ -14,13 +14,21 @@ subsystem persists that answer as artifacts instead:
   first-call-vs-steady-state split; ``utils.timing.PhaseTimer`` is now a
   thin compatibility shim over it.
 * :mod:`.report` — ``python -m distributed_drift_detection_tpu report
-  <run.jsonl>``: phase breakdown, throughput, drift timeline,
-  per-partition detection counts from a persisted run log.
+  <run.jsonl>``: phase breakdown, throughput, cost/memory section, drift
+  timeline, per-partition detection counts from a persisted run log.
+* :mod:`.profile` — compiler/device introspection (XLA
+  ``cost_analysis``/``memory_analysis``, ``device.memory_stats()``)
+  mapped onto the event schema and registry gauges.
+* :mod:`.perf` — ``python -m distributed_drift_detection_tpu perf
+  BENCH_r*.json``: per-cell diff of bench artifacts across rounds,
+  nonzero exit on gated regressions beyond a tolerance.
 
 Telemetry is **off by default** (``RunConfig.telemetry_dir=None``): every
 hook is an ``if log is not None`` guard outside the timed span, so the
-disabled path executes no telemetry code at all. This package never
-imports jax — the report CLI and the exporters work anywhere.
+disabled path executes no telemetry code at all. The package core never
+imports jax — the report and perf CLIs and the exporters work anywhere;
+:mod:`.profile` is the one module that talks to jax, and only lazily
+inside its functions.
 """
 
 from .events import (

@@ -48,6 +48,19 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "chunk_completed": ("chunk", "batches_done", "detections"),
     # soak progress: one per chained-soak leg (engine.soak.run_soak_chained)
     "leg_completed": ("leg", "rows", "detections"),
+    # XLA cost analysis of a compiled runner (telemetry.profile), extracted
+    # host-side after the timed span. ``where`` names the program (e.g.
+    # "detect_runner"); flops/bytes_accessed are None where the backend's
+    # cost model reports nothing — the full normalized map rides as the
+    # ``analysis`` extra.
+    "cost_analysis": ("where", "flops", "bytes_accessed"),
+    # A memory measurement: ``source`` = "memory_analysis" (compiler-
+    # reported argument/output/temp/generated-code bytes of a compiled
+    # runner) or "device" (``device.memory_stats()``, taken before/after
+    # the detect phase); ``stats`` is the non-empty numeric dict. Absence
+    # of a device snapshot means the backend reports none (XLA CPU) —
+    # never a fabricated zero.
+    "memory_snapshot": ("source", "stats"),
     # one per run log, last event: totals over the reference's Final Time
     "run_completed": ("rows", "seconds", "detections"),
 }
@@ -59,7 +72,13 @@ class SchemaError(ValueError):
 
 
 # The only required fields allowed to be null (see the schema notes above).
-_NULLABLE = frozenset({("drift_detected", "delay_rows")})
+_NULLABLE = frozenset(
+    {
+        ("drift_detected", "delay_rows"),
+        ("cost_analysis", "flops"),
+        ("cost_analysis", "bytes_accessed"),
+    }
+)
 
 
 def validate_event(event: object) -> dict:

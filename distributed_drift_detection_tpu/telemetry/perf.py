@@ -1,0 +1,342 @@
+"""Perf-regression diff over bench artifacts.
+
+    python -m distributed_drift_detection_tpu perf BENCH_r04.json BENCH_r05.json
+
+``bench.py`` prints one JSON line per invocation and the driver archives it
+per round (``BENCH_r*.json``); until now the trajectory could only be
+eyeballed. This CLI loads any mix of those artifacts, normalises each into
+a fixed set of **cells** (headline rows/s, Final Time, device-true detect
+time, compile split, phase medians, the soak/chunked riders, XLA cost/
+memory fields), prints a per-cell diff across rounds, and exits nonzero
+when a *gated* cell regresses beyond ``--tolerance`` — so CI can gate on
+the bench trajectory instead of a human rereading JSON.
+
+Artifact forms accepted, in order of preference:
+
+* the raw bench JSON line (``python bench.py > out.json``);
+* the driver wrapper ``{"cmd", "rc", "tail", "parsed"}`` with ``parsed``
+  holding the bench dict;
+* a wrapper whose ``tail`` contains the JSON line as text — including the
+  **head-truncated** case (the wrapper keeps only the last N bytes of
+  output): the line is repaired by re-opening the brace and dropping the
+  first, garbled key. Cells the truncation ate are re-derived where the
+  surviving fields allow: ``final_time_s`` from ``rep_times_s`` via the
+  same stall-aware selection bench.py uses (median of repetitions within
+  1.5× the fastest), ``value`` from ``rows / final_time_s``,
+  ``detect_time_s`` from the non-stalled ``phase_s`` medians.
+
+Gating semantics: only robust whole-run cells gate (throughput, Final
+Time, detect time, the soak/chunked headline rates); compile splits, phase
+medians, XLA counters and quality cells print informationally. A pair
+where either artifact is ``contended`` (≥ half its repetitions stalled —
+bench.py's own suspicion marker) reports its regressions as *suspect* and
+never fails the exit code: a stalling shared tunnel is not a code
+regression. ``--informational`` prints everything and always exits 0 (the
+CI trajectory job).
+
+Pure stdlib, no jax — runs wherever the artifacts land (same contract as
+the ``report`` CLI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+# Mirrors bench.py's stall-aware selection: the fastest repetition is
+# stall-free by construction; anything beyond 1.5× it is a stall.
+STALL_FACTOR = 1.5
+
+_UP, _DOWN = "up", "down"
+
+# (cell, better-direction, gated, unit). Order is the report order.
+CELLS = (
+    ("value", _UP, True, "rows/s"),
+    ("final_time_s", _DOWN, True, "s"),
+    ("detect_time_s", _DOWN, True, "s"),
+    ("compile_first_call_s", _DOWN, False, "s"),
+    ("compile_overhead_s", _DOWN, False, "s"),
+    ("phase_upload_s", _DOWN, False, "s"),
+    ("phase_collect_s", _DOWN, False, "s"),
+    ("soak_value", _UP, True, "rows/s"),
+    ("soak_xl_value", _UP, True, "rows/s"),
+    ("chunked_value", _UP, True, "rows/s"),
+    ("chunked_overlap_efficiency", _UP, False, ""),
+    ("xla_flops", _DOWN, False, "flops"),
+    ("xla_bytes_accessed", _DOWN, False, "B"),
+    ("xla_temp_bytes", _DOWN, False, "B"),
+    ("mean_delay_batches", _DOWN, False, "batches"),
+    ("detections", None, False, ""),
+)
+
+
+class ArtifactError(ValueError):
+    """The file holds no recoverable bench JSON."""
+
+
+def load_bench(path: str) -> tuple[dict, list[str]]:
+    """Load one bench artifact → ``(bench dict, provenance notes)``."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ArtifactError(f"{path}: not JSON ({e})") from None
+    if not isinstance(obj, dict):
+        raise ArtifactError(f"{path}: expected a JSON object")
+    if "metric" in obj or "value" in obj:
+        return obj, []  # the raw bench line
+    if "parsed" in obj or "tail" in obj:  # driver wrapper
+        if isinstance(obj.get("parsed"), dict):
+            return obj["parsed"], []
+        lines = (obj.get("tail") or "").strip().splitlines()
+        for line in reversed(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                parsed = json.loads(line)
+                # a stray scalar line ('0', 'true', an exit-code echo) is
+                # valid JSON but not a bench dict — keep scanning upward
+                if isinstance(parsed, dict):
+                    return parsed, []
+                continue
+            except json.JSONDecodeError:
+                # Head-truncated capture: the wrapper kept the last N bytes
+                # only, cutting mid-line. Re-open the object and drop the
+                # first key — its name is unknowable (the cut may have
+                # landed inside it), so its value cannot be trusted either.
+                try:
+                    fixed = json.loads('{"' + line.lstrip('{",'))
+                except json.JSONDecodeError:
+                    continue
+                garbled = next(iter(fixed), None)
+                if garbled is not None:
+                    fixed.pop(garbled)
+                return fixed, [
+                    "recovered from head-truncated tail "
+                    f"(dropped garbled first key {garbled!r})"
+                ]
+        raise ArtifactError(
+            f"{path}: wrapper holds no recoverable bench JSON "
+            f"(rc={obj.get('rc')})"
+        )
+    raise ArtifactError(f"{path}: not a bench artifact or driver wrapper")
+
+
+def _stall_split(times: list[float]) -> tuple[list[float], list[int]]:
+    floor = min(times)
+    stalled = [i for i, t in enumerate(times) if t > STALL_FACTOR * floor]
+    clean = [t for i, t in enumerate(times) if i not in stalled]
+    return clean, stalled
+
+
+def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
+    """Normalise one bench dict into the cell map (+ derivation notes)."""
+    cells: dict[str, float] = {}
+    notes: list[str] = []
+    rep = bench.get("rep_times_s") or []
+    stalled: list[int] | None = None
+
+    ft = bench.get("final_time_s")
+    if ft is None and rep:
+        clean, stalled = _stall_split(rep)
+        ft = statistics.median(clean)
+        notes.append("final_time_s derived from rep_times_s (stall-aware median)")
+    if ft is not None:
+        cells["final_time_s"] = float(ft)
+
+    val = bench.get("value")
+    if val is None and ft and bench.get("rows"):
+        val = float(bench["rows"]) / float(ft)
+        notes.append("value derived from rows / final_time_s")
+    if val is not None:
+        cells["value"] = float(val)
+
+    dt = bench.get("detect_time_s")
+    phase_s = bench.get("phase_s") or {}
+    if dt is None and phase_s.get("detect") and rep:
+        if stalled is None:
+            _, stalled = _stall_split(rep)
+        clean_d = [
+            t for i, t in enumerate(phase_s["detect"]) if i not in stalled
+        ]
+        if clean_d:
+            dt = statistics.median(clean_d)
+            notes.append("detect_time_s derived from phase_s (non-stalled median)")
+    if dt is not None:
+        cells["detect_time_s"] = float(dt)
+
+    comp = bench.get("compile_s") or {}
+    for src, dst in (
+        ("first_call_s", "compile_first_call_s"),
+        ("compile_overhead_s", "compile_overhead_s"),
+    ):
+        if comp.get(src) is not None:
+            cells[dst] = float(comp[src])
+    for name in ("upload", "collect"):
+        if phase_s.get(name):
+            cells[f"phase_{name}_s"] = float(statistics.median(phase_s[name]))
+
+    for k in (
+        "soak_value",
+        "soak_xl_value",
+        "chunked_value",
+        "chunked_overlap_efficiency",
+        "mean_delay_batches",
+        "detections",
+    ):
+        if bench.get(k) is not None:
+            cells[k] = float(bench[k])
+    xla = bench.get("xla") or {}
+    for k in ("flops", "bytes_accessed", "temp_bytes"):
+        if xla.get(k) is not None:
+            cells[f"xla_{k}"] = float(xla[k])
+    return cells, notes
+
+
+def _fmt(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 10_000:
+        return f"{v:,.0f}"
+    if abs(v) >= 100:
+        return f"{v:.1f}"
+    return f"{v:.4g}"
+
+
+class Regression:
+    def __init__(self, cell, old_name, new_name, pct, suspect):
+        self.cell, self.old_name, self.new_name = cell, old_name, new_name
+        self.pct, self.suspect = pct, suspect
+
+    def __str__(self):
+        s = "  (contended — suspect, not gated)" if self.suspect else ""
+        return (
+            f"{self.cell}: {self.old_name} → {self.new_name} "
+            f"{self.pct:+.1%}{s}"
+        )
+
+
+def diff_benches(
+    named: list[tuple[str, dict, list[str]]], tolerance: float
+) -> tuple[str, list[Regression]]:
+    """Render the per-cell diff table; returns ``(text, regressions)``.
+
+    ``regressions`` includes the *suspect* (contended-pair) ones — the
+    caller gates on ``[r for r in regressions if not r.suspect]``.
+    """
+    rows = []
+    cell_maps, all_notes, contended = [], [], []
+    for name, bench, notes in named:
+        cells, derived = bench_cells(bench)
+        cell_maps.append(cells)
+        contended.append(bool(bench.get("contended")))
+        all_notes.extend(f"{name}: {n}" for n in notes + derived)
+
+    width = max(12, *(len(n) for n, _, _ in named))
+    header = f"{'cell':<34}" + "".join(
+        f"{n:>{width + 2}}" for n, _, _ in named
+    )
+    if len(named) > 1:
+        header += f"{'Δ last':>10}"
+    rows.append(header)
+
+    regressions: list[Regression] = []
+    for cell, direction, gated, unit in CELLS:
+        vals = [m.get(cell) for m in cell_maps]
+        if all(v is None for v in vals):
+            continue
+        delta = ""
+        if len(vals) > 1 and vals[-2] not in (None, 0) and vals[-1] is not None:
+            pct = (vals[-1] - vals[-2]) / abs(vals[-2])
+            delta = f"{pct:+9.1%}"
+        arrow = ("↑" if direction == _UP else "↓") if direction else ""
+        qual = ", ".join(q for q in (unit, arrow) if q)
+        label = f"{cell} ({qual})" if qual else cell
+        rows.append(
+            f"{label:<34}"
+            + "".join(f"{_fmt(v):>{width + 2}}" for v in vals)
+            + (f"{delta:>10}" if len(named) > 1 else "")
+        )
+        if direction is None:
+            continue
+        for i in range(1, len(vals)):
+            a, b = vals[i - 1], vals[i]
+            if a in (None, 0) or b is None:
+                continue
+            pct = (b - a) / abs(a)
+            adverse = pct > tolerance if direction == _DOWN else pct < -tolerance
+            if gated and adverse:
+                regressions.append(
+                    Regression(
+                        cell, named[i - 1][0], named[i][0], pct,
+                        suspect=contended[i - 1] or contended[i],
+                    )
+                )
+
+    out = [
+        f"perf diff over {len(named)} artifact(s)  "
+        f"(gate tolerance {tolerance:.0%} on gated cells)",
+        "",
+    ]
+    out.extend(rows)
+    flagged = [n for (n, _, _), c in zip(named, contended) if c]
+    if flagged:
+        out.append("")
+        out.append(
+            "contended (≥ half the reps stalled — headline suspect): "
+            + ", ".join(flagged)
+        )
+    if all_notes:
+        out.append("")
+        out.extend(f"note: {n}" for n in all_notes)
+    out.append("")
+    if regressions:
+        out.append("REGRESSIONS beyond tolerance:")
+        out.extend(f"  {r}" for r in regressions)
+    else:
+        out.append("no gated regressions beyond tolerance")
+    return "\n".join(out), regressions
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu perf",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "artifacts", nargs="+",
+        help="bench artifact path(s), oldest first (raw bench JSON or "
+        "driver wrapper)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.10,
+        help="fractional adverse change beyond which a gated cell is a "
+        "regression (default 0.10)",
+    )
+    ap.add_argument(
+        "--informational", action="store_true",
+        help="print the diff but always exit 0 (the CI trajectory job)",
+    )
+    args = ap.parse_args(argv)
+    named = []
+    for p in args.artifacts:
+        try:
+            bench, notes = load_bench(p)
+        except ArtifactError as e:
+            raise SystemExit(f"perf: {e}")
+        named.append((os.path.basename(p), bench, notes))
+    text, regressions = diff_benches(named, args.tolerance)
+    print(text)
+    gating = [r for r in regressions if not r.suspect]
+    if gating and not args.informational:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

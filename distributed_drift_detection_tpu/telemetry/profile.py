@@ -1,0 +1,220 @@
+"""Compiler/device-level performance introspection.
+
+The event log and metrics registry (PR 1) explain where *host wall-clock*
+went at phase granularity; this module captures what the **compiler and
+devices** report, so "is the detect phase anywhere near what the compiled
+HLO could deliver" and "how much HBM does this (window × rotations ×
+partitions) configuration actually need" become offline-answerable too:
+
+* :func:`compiled_stats` — AOT-lower a jitted callable at concrete args and
+  read ``Compiled.cost_analysis()`` (flops, bytes accessed) and
+  ``Compiled.memory_analysis()`` (argument/output/temp/generated-code
+  bytes). Never raises: a backend that doesn't implement an analysis yields
+  ``None`` for that half, not a crashed run.
+* :func:`device_memory_stats` — ``device.memory_stats()`` filtered to its
+  numeric fields (``bytes_in_use``, ``peak_bytes_in_use``, …); ``None``
+  where the backend provides nothing (XLA CPU).
+* emit/record helpers mapping both onto the schema-v1 event types
+  (``cost_analysis``, ``memory_snapshot`` — :mod:`.events`) and the
+  registry gauges (``xla_*``, ``device_*`` — :mod:`.metrics`).
+
+Discipline (same as the rest of the telemetry package): everything here is
+host-side, runs only when telemetry/profiling is opted into, and is called
+strictly **outside** the reference-parity Final Time span — ``api.run``
+extracts compiled stats in its post-span ``_finish_telemetry`` and takes
+device-memory snapshots before the span opens / after it closes. The one
+real cost is :func:`compiled_stats` re-lowering and AOT-compiling the
+runner (a host-side re-trace plus roughly one extra XLA compile, unless a
+persistent compile cache serves it — bench.py enables one) — the opt-in
+observability trade, paid after the span.
+
+Unlike the package's jax-free core, this module *talks to* jax — but only
+lazily inside functions, so importing :mod:`telemetry` (the report/perf
+CLI path) still never initialises a backend.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "compiled_stats",
+    "device_memory_stats",
+    "emit_compiled_events",
+    "emit_device_memory_event",
+    "memory_analysis_dict",
+    "normalize_cost_analysis",
+    "record_compiled_gauges",
+    "record_device_memory_gauges",
+]
+
+# CompiledMemoryStats attributes persisted (device-relevant sizes; the
+# host_* mirror fields are zero everywhere this framework runs).
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def normalize_cost_analysis(raw) -> dict | None:
+    """``Compiled.cost_analysis()`` → one flat ``{metric: float}`` dict.
+
+    Normalises the cross-version/backend shapes: jax ≤ 0.4.x wraps the map
+    in a one-element list, keys use spaces (``"bytes accessed"``) — emitted
+    keys are underscore-joined (``bytes_accessed``) so they are valid
+    metric/JSON identifiers. Non-numeric values are dropped."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for k, v in raw.items():
+        try:
+            out[str(k).replace(" ", "_")] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
+
+
+def memory_analysis_dict(ma) -> dict | None:
+    """``Compiled.memory_analysis()`` → ``{argument_bytes, output_bytes,
+    temp_bytes, alias_bytes, generated_code_bytes}`` (ints), or ``None``
+    when the backend returns nothing."""
+    if ma is None:
+        return None
+    out = {}
+    for field in _MEMORY_FIELDS:
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[field.replace("_size_in_bytes", "_bytes")] = int(v)
+    return out or None
+
+
+def compiled_stats(jitted, *args, **kwargs) -> dict:
+    """AOT-lower ``jitted`` at ``args`` → ``{"cost": ..., "memory": ...}``.
+
+    Both halves are ``None`` when unavailable (backend without the
+    analysis, or a callable that refuses to lower) — introspection must
+    never take down the run it describes. Prefer calling with the SAME
+    (committed, sharded) arguments the runner executed with, so the
+    analyzed program is the executed one; host arrays with matching avals
+    lower a default-placement twin instead. ``.compile()`` costs roughly
+    one extra XLA compile unless a persistent compile cache serves it.
+    """
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception:
+        return {"cost": None, "memory": None}
+    cost = memory = None
+    try:
+        cost = normalize_cost_analysis(compiled.cost_analysis())
+    except Exception:
+        pass
+    try:
+        memory = memory_analysis_dict(compiled.memory_analysis())
+    except Exception:
+        pass
+    return {"cost": cost, "memory": memory}
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """Numeric fields of ``device.memory_stats()``; ``None`` where the
+    backend provides none (XLA CPU) or the call fails."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {
+        k: v
+        for k, v in stats.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+    return out or None
+
+
+# -- event emission ---------------------------------------------------------
+
+
+def emit_compiled_events(log, stats: dict, where: str = "detect_runner") -> None:
+    """Emit one ``cost_analysis`` (+ one ``memory_snapshot`` when the
+    compiler reported memory sizes) from a :func:`compiled_stats` result.
+    No-op when both halves are ``None``."""
+    cost, memory = stats.get("cost"), stats.get("memory")
+    if cost is None and memory is None:
+        return
+    cost = cost or {}
+    log.emit(
+        "cost_analysis",
+        where=where,
+        flops=cost.get("flops"),
+        bytes_accessed=cost.get("bytes_accessed"),
+        analysis=cost or None,
+    )
+    if memory:
+        log.emit(
+            "memory_snapshot", source="memory_analysis", stats=memory,
+            where=where,
+        )
+
+
+def emit_device_memory_event(log, stats: dict | None, when: str) -> None:
+    """Emit one device ``memory_snapshot`` (no-op when the backend gave
+    nothing — absence of a snapshot means "backend doesn't report", never
+    a fabricated zero)."""
+    if stats:
+        log.emit("memory_snapshot", source="device", stats=stats, when=when)
+
+
+# -- registry gauges --------------------------------------------------------
+
+
+def record_compiled_gauges(registry, stats: dict) -> None:
+    """Record a :func:`compiled_stats` result as ``xla_*`` gauges."""
+    cost = stats.get("cost") or {}
+    for key, name in (
+        ("flops", "xla_flops"),
+        ("bytes_accessed", "xla_bytes_accessed"),
+    ):
+        if cost.get(key) is not None:
+            registry.gauge(
+                name, help=f"XLA cost analysis: {key} per runner execution"
+            ).set(cost[key])
+    for key, value in (stats.get("memory") or {}).items():
+        registry.gauge(
+            f"xla_{key}", help=f"XLA memory analysis: {key}"
+        ).set(value)
+
+
+def record_device_memory_gauges(
+    registry, stats: dict | None, when: str = ""
+) -> None:
+    """Record a device-memory snapshot as gauges (no-op on ``None``).
+
+    ``device_bytes_in_use{when=...}`` is last-write-wins per label (the
+    engines call this per chunk/leg — the gauge tracks the latest point);
+    ``device_peak_bytes_in_use`` keeps the max across every call, so a
+    transient allocation spike between snapshots the backend itself peaked
+    on is not lost to the last-write semantics."""
+    if not stats:
+        return
+    in_use = stats.get("bytes_in_use")
+    if in_use is not None:
+        g = registry.gauge(
+            "device_bytes_in_use", help="Device memory in use at snapshot"
+        )
+        g.set(in_use, **({"when": when} if when else {}))
+    peak = stats.get("peak_bytes_in_use", in_use)
+    if peak is not None:
+        g = registry.gauge(
+            "device_peak_bytes_in_use",
+            help="Max device bytes in use across snapshots",
+        )
+        prior = g.values.get((), float("-inf"))
+        g.set(max(float(peak), prior))

@@ -3,10 +3,13 @@
     python -m distributed_drift_detection_tpu report <run.jsonl>
 
 Answers the post-hoc questions the reference needs a re-run for: where the
-time went (phase breakdown), how fast it ran (throughput), when and where
-drift fired (ascii timeline over the stream + per-partition counts), and —
-for streaming/soak logs — per-chunk/per-leg progress. Pure stdlib + the
-schema module; no jax, so it runs anywhere the artifact lands.
+time went (phase breakdown), how fast it ran (throughput), what the
+compiler said the detect program costs and how close the run came to it
+(cost/memory section: flops, bytes, peak temp allocation, achieved
+GFLOP/s — from the ``cost_analysis``/``memory_snapshot`` events), when and
+where drift fired (ascii timeline over the stream + per-partition counts),
+and — for streaming/soak logs — per-chunk/per-leg progress. Pure stdlib +
+the schema module; no jax, so it runs anywhere the artifact lands.
 """
 
 from __future__ import annotations
@@ -34,6 +37,9 @@ def summarize(events: list[dict]) -> dict:
         "chunks": [],
         "legs": [],
         "completed": None,
+        "cost": None,
+        "mem_analysis": None,
+        "device_mem": {},
     }
     for e in events:
         t = e["type"]
@@ -55,9 +61,28 @@ def summarize(events: list[dict]) -> dict:
             s["chunks"].append(e)
         elif t == "leg_completed":
             s["legs"].append(e)
+        elif t == "cost_analysis":
+            s["cost"] = e
+        elif t == "memory_snapshot":
+            if e["source"] == "memory_analysis":
+                s["mem_analysis"] = e["stats"]
+            else:  # device snapshots, keyed by their `when` label
+                s["device_mem"][e.get("when") or f"snap{len(s['device_mem'])}"] = (
+                    e["stats"]
+                )
         elif t == "run_completed":
             s["completed"] = e
     return s
+
+
+def _fmt_bytes(n: float) -> str:
+    """Human bytes with binary units (exact ints below 1 KiB)."""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} TiB"  # unreachable; keeps type-checkers calm
 
 
 def _timeline(positions: list[int], rows: int, bins: int = _TIMELINE_BINS) -> str:
@@ -119,6 +144,67 @@ def render_report(events: list[dict]) -> str:
         )
     else:
         out.append("throughput <run incomplete: no run_completed event>")
+
+    # Achieved vs available (telemetry.profile): what the compiler's cost
+    # model says one runner execution is worth, against the detect phase's
+    # wall-clock — over-firing kernels (flops per row jumps) and host-bound
+    # runs (tiny achieved GFLOP/s with a healthy detect share) read
+    # differently here, offline.
+    cost = s["cost"] or {}
+    flops = cost.get("flops")
+    if s["cost"] is not None:
+        where = cost.get("where") or "runner"
+        parts = []
+        if flops is not None:
+            parts.append(f"flops {flops:.4g}")
+        if cost.get("bytes_accessed") is not None:
+            parts.append(f"bytes accessed {_fmt_bytes(cost['bytes_accessed'])}")
+        out.append(
+            "cost model "
+            + ("  ".join(parts) if parts else "<backend reported none>")
+            + f"  ({where}, per execution)"
+        )
+    if s["mem_analysis"]:
+        ma = s["mem_analysis"]
+        segs = [
+            f"{label} {_fmt_bytes(ma[k])}"
+            for k, label in (
+                ("argument_bytes", "args"),
+                ("output_bytes", "out"),
+                ("temp_bytes", "peak temp"),
+                ("generated_code_bytes", "code"),
+            )
+            if ma.get(k) is not None
+        ]
+        if segs:
+            out.append("xla memory " + "  ".join(segs))
+    if s["device_mem"]:
+        segs = []
+        # emit order, not alphabetical: before_detect must read before
+        # after_detect or the across-the-span delta reads backwards
+        for when, st in s["device_mem"].items():
+            if st.get("bytes_in_use") is not None:
+                segs.append(f"{when} {_fmt_bytes(st['bytes_in_use'])}")
+        peak = max(
+            (
+                st.get("peak_bytes_in_use", 0) or 0
+                for st in s["device_mem"].values()
+            ),
+            default=0,
+        )
+        if peak:
+            segs.append(f"peak {_fmt_bytes(peak)}")
+        if segs:
+            out.append("device mem in use: " + "  ".join(segs))
+    detect_s = s["phases"].get("detect")
+    if flops and detect_s:
+        line = (
+            f"achieved   {flops / detect_s / 1e9:.3f} GFLOP/s over detect "
+            f"{detect_s:.4f} s  (cost-model flops / detect wall-clock)"
+        )
+        if rows:
+            line += f"  ·  {flops / rows:.1f} flops/row"
+        out.append(line)
 
     drifts = s["drifts"]
     n_det = done["detections"] if done else len(drifts)

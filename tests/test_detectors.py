@@ -700,6 +700,63 @@ def test_adwin_rejects_bad_params():
         adwin_batch(adwin_init(), e, v, ADWINParams(max_buckets=1))
 
 
+def test_adwin_indicator_debug_guard():
+    """Opt-in 0/1-indicator guard (advisor round-5): real-valued errors are
+    silently truncated to 0 by the kernel's exact-int32 casts — with the
+    guard on they fail the device program loudly instead; masked-invalid
+    and genuine 0/1 inputs pass. Off (the default), behaviour is unchanged
+    (same compiled graph — the gate is trace-time)."""
+    from distributed_drift_detection_tpu.ops import adwin as adwin_mod
+
+    real = jnp.full(8, 0.5, jnp.float32)
+    v = jnp.ones(8, bool)
+    # default off: the historical (silently-truncating) behaviour holds
+    state, res = adwin_batch(adwin_init(), real, v)
+    assert int(res.first_change) == -1
+
+    adwin_mod.set_debug_indicator_checks(True)
+    try:
+        ok = jnp.array([0.0, 1.0, 1.0, 0.0], jnp.float32)
+        adwin_batch(adwin_init(), ok, jnp.ones(4, bool))  # indicators pass
+        # invalid (masked) rows may hold anything
+        masked = jnp.array([0.0, 0.5, 1.0, 2.0], jnp.float32)
+        adwin_batch(
+            adwin_init(), masked, jnp.array([True, False, True, False])
+        )
+        with pytest.raises(Exception, match="non-indicator"):
+            jax.block_until_ready(adwin_batch(adwin_init(), real, v))
+        with pytest.raises(Exception, match="non-indicator"):
+            jax.block_until_ready(
+                adwin_step(adwin_init(), jnp.float32(0.25))
+            )
+        # the windowed form guards too, including under jit
+        with pytest.raises(Exception, match="non-indicator"):
+            jax.block_until_ready(
+                jax.jit(adwin_window)(
+                    adwin_init(), real.reshape(2, 4), v.reshape(2, 4)
+                )
+            )
+    finally:
+        adwin_mod.set_debug_indicator_checks(None)
+
+
+def test_adwin_indicator_guard_env_semantics(monkeypatch):
+    """DDD_DEBUG_INDICATORS follows conventional boolean env semantics:
+    '0'/'false'/'off'/'' mean OFF (a user disabling explicitly must not get
+    the host-callback overhead), anything else means on."""
+    from distributed_drift_detection_tpu.ops import adwin as adwin_mod
+
+    adwin_mod.set_debug_indicator_checks(None)  # defer to the env var
+    for off in ("", "0", "false", "OFF", "no"):
+        monkeypatch.setenv("DDD_DEBUG_INDICATORS", off)
+        assert not adwin_mod._indicator_checks_enabled(), off
+    for on in ("1", "true", "yes", "debug"):
+        monkeypatch.setenv("DDD_DEBUG_INDICATORS", on)
+        assert adwin_mod._indicator_checks_enabled(), on
+    monkeypatch.delenv("DDD_DEBUG_INDICATORS")
+    assert not adwin_mod._indicator_checks_enabled()
+
+
 def test_stepd_rejects_bad_params():
     with pytest.raises(ValueError, match="alpha_drift"):
         make_detector("stepd", stepd=STEPDParams(alpha_drift=0.0))

@@ -79,6 +79,39 @@ def test_window_runner_indexed_equals_dense(shuffle):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_window_indexed_row_table_computes_in_f32():
+    """The 'engines compute in f32' invariant (advisor round-5) must hold
+    for the indexed plane layout too: a narrower transport dtype on the
+    row table is cast on device before any model math — every predict and
+    the carried batch_a see float32, and with values exactly representable
+    in the narrow dtype the flags stay bit-identical to the f32 table."""
+    rng = np.random.default_rng(0)
+    T, F, nb, b = 24, 4, 12, 8
+    # quarter-step values: exact in float16, so the cast is the ONLY
+    # difference between the two runs
+    base_X = (rng.integers(-32, 32, (T, F)).astype(np.float32) / 4.0)
+    base_y = rng.integers(0, 3, T).astype(np.int32)
+    idx = rng.integers(0, T, (nb, b)).astype(np.int32)
+    rows = np.arange(nb * b, dtype=np.int32).reshape(nb, b)
+    valid = np.ones((nb, b), bool)
+    f32 = IndexedBatches(base_X, base_y, idx, rows, valid)
+    f16 = f32._replace(base_X=base_X.astype(np.float16))
+
+    model = build_model("centroid", ModelSpec(F, 3))
+    seen = []
+    orig_predict = model.predict
+    spy = model._replace(
+        predict=lambda p, X: (seen.append(X.dtype), orig_predict(p, X))[1]
+    )
+    run_w = jax.jit(make_window_runner(spy, DDMParams(), window=4, shuffle=False))
+    key = jax.random.key(7)
+    out16 = run_w(f16, key)
+    assert seen and all(d == np.float32 for d in seen)  # recorded at trace
+    out32 = run_w(f32, key)
+    for a, c in zip(out32, out16):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
 def test_api_run_uses_indexed_path_and_matches_dense():
     """End-to-end: api.run on a duplicated outdoorStream must produce the
     same flags/metrics whether the compressed path is taken (window>1) or

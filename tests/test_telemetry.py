@@ -36,6 +36,12 @@ EXAMPLE_EVENTS = {
     "retrain": dict(partition=0, batch=7, forced=True),
     "chunk_completed": dict(chunk=2, batches_done=256, detections=4),
     "leg_completed": dict(leg=1, rows=100_000, detections=9),
+    "cost_analysis": dict(
+        where="detect_runner", flops=1.57e8, bytes_accessed=1.89e8
+    ),
+    "memory_snapshot": dict(
+        source="memory_analysis", stats={"temp_bytes": 14_401_584}
+    ),
     "run_completed": dict(rows=2_048_000, seconds=0.16, detections=600),
 }
 
@@ -63,8 +69,19 @@ def test_nullable_delay_and_extra_fields(tmp_path):
             "drift_detected", partition=0, global_pos=5, delay_rows=None,
             batch=1,  # extra payload fields are allowed (forward compat)
         )
-    (e,) = read_events(path)
+        # cost_analysis flops/bytes are nullable (a backend without a cost
+        # model reports nothing); memory_snapshot.stats is not.
+        log.emit(
+            "cost_analysis", where="detect_runner", flops=None,
+            bytes_accessed=None,
+        )
+    e, c = read_events(path)
     assert e["delay_rows"] is None and e["batch"] == 1
+    assert c["flops"] is None
+    log = EventLog(path)
+    with pytest.raises(SchemaError, match="null required"):
+        log.emit("memory_snapshot", source="device", stats=None)
+    log.close()
 
 
 def test_emit_rejects_unknown_type_and_missing_fields(tmp_path):
@@ -475,6 +492,239 @@ def test_soak_chained_emits_leg_events(tmp_path):
     assert s.legs >= 2  # max_leg_rows forced a real chain
     assert sum(e["rows"] for e in events) == s.rows_processed
     assert sum(e["detections"] for e in events) == s.detections
+
+
+# ---------------------------------------------------------------------------
+# Compiler/device introspection (telemetry.profile)
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_cost_analysis_shapes():
+    from distributed_drift_detection_tpu.telemetry.profile import (
+        normalize_cost_analysis,
+    )
+
+    # jax ≤ 0.4.x wraps in a one-element list; keys carry spaces.
+    raw = [{"flops": 100.0, "bytes accessed": 64.0, "weird": "skip-me"}]
+    assert normalize_cost_analysis(raw) == {
+        "flops": 100.0, "bytes_accessed": 64.0,
+    }
+    assert normalize_cost_analysis(raw[0])["flops"] == 100.0
+    assert normalize_cost_analysis(None) is None
+    assert normalize_cost_analysis([]) is None
+    assert normalize_cost_analysis({"only": "strings"}) is None
+
+
+def test_compiled_stats_on_cpu_backend():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_drift_detection_tpu.telemetry.profile import (
+        compiled_stats,
+    )
+
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    stats = compiled_stats(f, jnp.ones((32, 32)))
+    assert stats["cost"]["flops"] > 0
+    assert stats["cost"]["bytes_accessed"] > 0
+    assert stats["memory"]["argument_bytes"] == 32 * 32 * 4
+    # failure-safe: a non-lowerable callable yields Nones, not a raise
+    assert compiled_stats(object()) == {"cost": None, "memory": None}
+
+
+def test_device_memory_gauges_peak_semantics():
+    from distributed_drift_detection_tpu.telemetry.profile import (
+        record_device_memory_gauges,
+    )
+
+    reg = MetricsRegistry()
+    record_device_memory_gauges(reg, None, when="x")  # backend gave nothing
+    assert reg.to_json() == {}
+    record_device_memory_gauges(
+        reg, {"bytes_in_use": 100, "peak_bytes_in_use": 150}, when="leg"
+    )
+    record_device_memory_gauges(
+        reg, {"bytes_in_use": 120, "peak_bytes_in_use": 130}, when="leg"
+    )
+    g = reg.gauge("device_bytes_in_use")
+    assert g.values[(("when", "leg"),)] == 120  # latest point
+    # peak keeps the max across snapshots, not the last write
+    assert reg.gauge("device_peak_bytes_in_use").values[()] == 150
+
+
+def test_api_run_emits_cost_and_memory_events(tmp_path):
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0", mult_data=1, partitions=2,
+        per_batch=50, model="centroid", results_csv="",
+        telemetry_dir=str(tmp_path / "tele"),
+    )
+    res = run(cfg)
+    events = read_events(res.telemetry_path)
+    (cost,) = [e for e in events if e["type"] == "cost_analysis"]
+    assert cost["where"] == "detect_runner"
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0  # CPU cost model
+    mem = [e for e in events if e["type"] == "memory_snapshot"]
+    (ma,) = [e for e in mem if e["source"] == "memory_analysis"]
+    assert ma["stats"]["temp_bytes"] >= 0 and "argument_bytes" in ma["stats"]
+    # XLA CPU reports no device.memory_stats — no fabricated device snaps
+    assert all(e["source"] == "memory_analysis" for e in mem)
+
+    # gauges ride the metric exports
+    base = os.path.splitext(res.telemetry_path)[0]
+    samples = parse_prometheus_text(open(base + ".prom").read())
+    assert samples[("xla_flops", ())] == cost["flops"]
+    assert samples[("xla_temp_bytes", ())] == ma["stats"]["temp_bytes"]
+
+    # the report renders the cost/memory section from the real artifact
+    out = render_report(events)
+    assert "cost model" in out and "peak temp" in out
+    assert "achieved" in out and "GFLOP/s" in out
+
+
+def test_profile_extraction_outside_timed_span(tmp_path, monkeypatch):
+    """The acceptance invariant: with telemetry off the timed span runs the
+    exact same instrumentation calls as before this subsystem existed (and
+    no profile code at all); with telemetry on, every profile call lands
+    outside the [span start, span end] region — before upload or after
+    collect, never between."""
+    import distributed_drift_detection_tpu.api as api_mod
+    from distributed_drift_detection_tpu.telemetry import profile as profile_mod
+
+    markers = []
+
+    def tap(name, fn):
+        def wrapped(*a, **k):
+            markers.append(name)
+            return fn(*a, **k)
+
+        return wrapped
+
+    # shard_batches/unpack_flags bracket the timed span (upload + collect).
+    monkeypatch.setattr(
+        api_mod, "shard_batches", tap("span_upload", api_mod.shard_batches)
+    )
+    monkeypatch.setattr(
+        api_mod, "unpack_flags", tap("span_collect", api_mod.unpack_flags)
+    )
+    monkeypatch.setattr(
+        profile_mod,
+        "compiled_stats",
+        tap("profile_compiled", profile_mod.compiled_stats),
+    )
+    monkeypatch.setattr(
+        profile_mod,
+        "device_memory_stats",
+        tap("profile_device", profile_mod.device_memory_stats),
+    )
+
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0", mult_data=1, partitions=2,
+        per_batch=50, model="centroid", results_csv="",
+    )
+    run(cfg)
+    # telemetry off: the timed span's instrumentation is unchanged — no
+    # profile calls anywhere, exactly one upload and one collect.
+    assert markers == ["span_upload", "span_collect"]
+
+    markers.clear()
+    run(replace(cfg, telemetry_dir=str(tmp_path / "tele")))
+    up, col = markers.index("span_upload"), markers.index("span_collect")
+    # nothing profile-ish inside the span...
+    assert markers[up + 1 : col] == []
+    # ...the pre-detect snapshot lands before it, the rest after.
+    assert markers[:up].count("profile_device") == 1
+    after = markers[col + 1 :]
+    assert "profile_compiled" in after and "profile_device" in after
+
+
+def test_report_partial_log_with_cost_events(tmp_path):
+    """A crashed run whose log got as far as the compiler introspection
+    still renders — cost/memory section included, throughput marked
+    incomplete (the append-only sink's whole point)."""
+    log = EventLog.open_run(str(tmp_path), name="crashed")
+    log.emit("run_started", run_id=log.run_id, config={"model": "centroid"})
+    log.emit("phase_completed", phase="detect", seconds=2.0)
+    log.emit(
+        "cost_analysis", where="detect_runner", flops=2.0e9,
+        bytes_accessed=1.0e8,
+    )
+    log.emit(
+        "memory_snapshot",
+        source="memory_analysis",
+        stats={"argument_bytes": 1024, "temp_bytes": 2048,
+               "output_bytes": 64, "generated_code_bytes": 0},
+    )
+    log.emit(
+        "memory_snapshot", source="device",
+        stats={"bytes_in_use": 10_000, "peak_bytes_in_use": 20_000},
+        when="before_detect",
+    )
+    log.emit(
+        "memory_snapshot", source="device",
+        stats={"bytes_in_use": 12_000}, when="after_detect",
+    )
+    log.close()
+    out = render_report(read_events(log.path))
+    assert "run incomplete" in out
+    assert "cost model flops 2e+09" in out
+    assert "peak temp 2.0 KiB" in out
+    assert "device mem in use" in out and "peak 19.5 KiB" in out
+    # emit order, not alphabetical: before_detect reads before after_detect
+    assert out.index("before_detect") < out.index("after_detect")
+    # achieved GFLOP/s needs only the detect phase, not run_completed
+    assert "1.000 GFLOP/s" in out
+
+
+def test_chunked_run_records_memory_gauges(monkeypatch):
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.feeder import chunk_stream_arrays
+    from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+    from distributed_drift_detection_tpu.telemetry import profile as profile_mod
+
+    import itertools
+
+    snaps = (
+        {"bytes_in_use": 1000 * (i + 1), "peak_bytes_in_use": 1500 * (i + 1)}
+        for i in itertools.count()
+    )
+    monkeypatch.setattr(
+        profile_mod, "device_memory_stats", lambda *a, **k: next(snaps)
+    )
+    X, y = rialto_like_xy(seed=0)
+    p, b, cb = 2, 50, 8
+    model = build_model("centroid", ModelSpec(X.shape[1], int(y.max()) + 1))
+    det = ChunkedDetector(model, partitions=p, seed=0)
+    reg = MetricsRegistry()
+    det.run(chunk_stream_arrays(X, y, p, b, cb), metrics=reg)
+    n_chunks = -(-len(y) // (p * b * cb))
+    g = reg.gauge("device_bytes_in_use")
+    assert g.values[(("when", "chunk"),)] == 1000 * n_chunks  # latest chunk
+    assert reg.gauge("device_peak_bytes_in_use").values[()] == 1500 * n_chunks
+
+
+def test_soak_chained_records_leg_memory_gauges(monkeypatch):
+    from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+    from distributed_drift_detection_tpu.telemetry import profile as profile_mod
+
+    values = iter(range(1, 100))
+    monkeypatch.setattr(
+        profile_mod,
+        "device_memory_stats",
+        lambda *a, **k: {"bytes_in_use": 4096 * next(values)},
+    )
+    model = build_model("centroid", ModelSpec(8, 8))
+    reg = MetricsRegistry()
+    s = run_soak_chained(
+        model, partitions=2, per_batch=50, total_rows=4000,
+        drift_every=500, max_leg_rows=2000, metrics=reg,
+    )
+    assert s.legs >= 2
+    g = reg.gauge("device_bytes_in_use")
+    assert g.values[(("when", "leg"),)] == 4096 * s.legs  # one per leg
+    # without a reported peak field, peak falls back to bytes_in_use max
+    assert reg.gauge("device_peak_bytes_in_use").values[()] == 4096 * s.legs
 
 
 def test_feeder_ingest_counters():
