@@ -19,15 +19,22 @@ run's telemetry artifacts; mutually exclusive with ``--trace-dir``), and
 ``--telemetry-dir DIR`` persists the structured JSONL run log + metric
 exports (telemetry subsystem).
 
-Two further subcommands work offline (no accelerator, no data — just the
+Four further subcommands work offline (no accelerator, no data — just the
 artifacts):
 
-    python -m distributed_drift_detection_tpu report <run.jsonl> [...]
+    python -m distributed_drift_detection_tpu report <run.jsonl | --dir DIR>
     python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
+    python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
+    python -m distributed_drift_detection_tpu correlate <DIR | logs...>
 
-``report`` renders a persisted run log; ``perf`` diffs bench artifacts
-across rounds per cell and exits nonzero on gated regressions beyond a
-tolerance (telemetry.perf).
+``report`` renders a persisted run log (``--dir`` picks a telemetry
+directory's newest run); ``perf`` diffs bench artifacts across rounds per
+cell and exits nonzero on gated regressions beyond a tolerance
+(telemetry.perf); ``watch`` live-tails a run log — progress/ETA from
+heartbeats, exit 3 past ``--stall-after`` (telemetry.watch, the
+scriptable health check); ``correlate`` merges a multi-host run's
+per-process logs into one timeline with straggler diagnostics
+(telemetry.correlate).
 """
 
 import sys
@@ -37,7 +44,9 @@ _USAGE = (
     "[--trace-dir DIR] [--profile-dir DIR] [--telemetry-dir DIR] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
-    "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]"
+    "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
+    "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
+    "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS"
 )
 
 
@@ -68,6 +77,18 @@ def main(argv: list[str]) -> None:
         from .telemetry.perf import main as perf_main
 
         perf_main(argv[1:])
+        return
+    if argv and argv[0] == "watch":
+        # jax-free: the health check runs on pod hosts and in CI gates.
+        from .telemetry.watch import main as watch_main
+
+        watch_main(argv[1:])
+        return
+    if argv and argv[0] == "correlate":
+        # jax-free: multi-host logs are merged wherever they are mirrored.
+        from .telemetry.correlate import main as correlate_main
+
+        correlate_main(argv[1:])
         return
 
     argv = list(argv)

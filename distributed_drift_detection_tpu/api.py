@@ -261,34 +261,57 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     # Telemetry (off by default): the event log is opened before the work
     # and written AFTER the Final Time span closes — nothing below touches
     # the timed region, and with telemetry_dir unset no telemetry code runs.
+    # Each process of a multi-host run opens its OWN log (the procN filename
+    # segment + the run_started host-identity extras are what the correlate
+    # CLI merges on), and registers it in the directory's index.jsonl so the
+    # fleet view (which runs exist, did they finish) never requires parsing
+    # every log.
     log = None
+    ident = None
     if cfg.telemetry_dir:
+        from .parallel.multihost import host_identity
+        from .telemetry import registry as run_registry
         from .telemetry.events import EventLog
 
-        log = EventLog.open_run(cfg.telemetry_dir, name=cfg.resolved_app_name())
+        ident = host_identity()
+        log = EventLog.open_run(
+            cfg.telemetry_dir,
+            name=cfg.resolved_app_name(),
+            process_index=ident["process_index"],
+        )
 
     # try/finally, not context manager: a failed run (bad dataset path, flag
     # audit rejection, full telemetry volume on the very first emit) must
     # still release the log's fd — the partial log is the crash evidence
     # (lines are flushed per emit), but a long-lived process catching
-    # per-run errors must not leak a descriptor per failure.
+    # per-run errors must not leak a descriptor per failure. The registry
+    # gets the matching terminal record either way: a crashed run reads as
+    # status=failed in index.jsonl, not as an unexplained absence.
     try:
         if log is not None:
+            config_payload = {
+                "dataset": str(cfg.dataset),
+                "model": cfg.model,
+                "detector": cfg.detector,
+                "partitions": cfg.partitions,
+                "per_batch": cfg.per_batch,
+                "mult_data": cfg.mult_data,
+                "seed": cfg.seed,
+                "backend": cfg.backend,
+                "window": cfg.window,  # 0 = auto; resolved rides on
+                "window_rotations": cfg.window_rotations,  # compile event
+            }
             log.emit(
-                "run_started",
-                run_id=log.run_id,
-                config={
-                    "dataset": str(cfg.dataset),
-                    "model": cfg.model,
-                    "detector": cfg.detector,
-                    "partitions": cfg.partitions,
-                    "per_batch": cfg.per_batch,
-                    "mult_data": cfg.mult_data,
-                    "seed": cfg.seed,
-                    "backend": cfg.backend,
-                    "window": cfg.window,  # 0 = auto; resolved rides on
-                    "window_rotations": cfg.window_rotations,  # compile event
-                },
+                "run_started", run_id=log.run_id, config=config_payload,
+                **ident,
+            )
+            run_registry.record(
+                cfg.telemetry_dir,
+                log.run_id,
+                "running",
+                config_digest=run_registry.config_digest(config_payload),
+                log=os.path.basename(log.path),
+                **ident,
             )
         with timer.phase("prepare"):
             prep = prepare(cfg, stream)
@@ -383,6 +406,24 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
                 # program the span ran, not a default-placement twin.
                 runner_args=(dev_batches, dev_keys),
             )
+            run_registry.record(
+                cfg.telemetry_dir,
+                log.run_id,
+                "completed",
+                rows=stream.num_rows,
+                seconds=total_time,
+                detections=m.num_detections,
+            )
+    except BaseException:
+        if log is not None:
+            try:
+                run_registry.record(cfg.telemetry_dir, log.run_id, "failed")
+            except Exception:
+                # Best-effort crash evidence: the volume that broke the run
+                # (e.g. full telemetry disk) may break this append too —
+                # the run's own exception is the one that must surface.
+                pass
+        raise
     finally:
         if log is not None:
             log.close()  # idempotent; _finish_telemetry closes on success

@@ -153,6 +153,12 @@ class ChunkedDetector:
         self._seed = seed
         self.carry: LoopCarry | None = None
         self.batches_done = 0
+        # Liveness bookkeeping for the heartbeat event: rows fed so far
+        # (padding rows included — this is a progress beacon, not delay
+        # accounting) and a monotonic feed-start stamp, so a host clock
+        # step mid-run cannot fake progress for the `watch` CLI.
+        self.rows_done = 0
+        self._feed_started: float | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -179,6 +185,13 @@ class ChunkedDetector:
         Does not block: results are JAX async values, so the caller can
         prefetch/construct the next chunk while the device runs.
         """
+        import time
+
+        if self._feed_started is None:
+            self._feed_started = time.monotonic()
+        self.rows_done += int(
+            chunk.y.shape[0] * chunk.y.shape[1] * chunk.y.shape[2]
+        )
         if self._sharding is not None:
             chunk = jax.device_put(chunk, self._sharding)
         else:
@@ -231,6 +244,26 @@ class ChunkedDetector:
             self.record_memory_gauges(metrics)
         return flags, detections
 
+    def emit_heartbeat(self, telemetry) -> dict:
+        """Emit the liveness beacon: rows fed so far + monotonic seconds
+        since the first ``feed``. Host-side bookkeeping only — no device
+        sync, no jitted code; the ``watch`` CLI turns the stream of these
+        into progress/ETA and stall detection. ``batches_done`` rides as
+        an extra for humans reading the raw log."""
+        import time
+
+        elapsed = (
+            time.monotonic() - self._feed_started
+            if self._feed_started is not None
+            else 0.0
+        )
+        return telemetry.emit(
+            "heartbeat",
+            rows_done=self.rows_done,
+            elapsed_s=elapsed,
+            batches_done=self.batches_done,
+        )
+
     def run(
         self,
         chunks: Iterator[Batches],
@@ -241,9 +274,11 @@ class ChunkedDetector:
         """Drain an iterator of chunks; concatenates flags on host.
 
         ``telemetry`` (a :class:`..telemetry.events.EventLog`) emits one
-        ``chunk_completed`` progress event per chunk, with the detection
-        count extracted from that chunk's collected flag table. The
-        extraction forces the chunk's device→host sync at chunk granularity
+        ``chunk_completed`` progress event per chunk (detection count
+        extracted from that chunk's collected flag table) followed by one
+        ``heartbeat`` (rows fed + monotonic elapsed — the ``watch`` CLI's
+        liveness signal). The flag extraction forces the chunk's
+        device→host sync at chunk granularity
         — the opt-in observability trade; without telemetry the host copy
         stays deferred to the final concat and nothing here synchronizes.
         ``metrics`` records the per-chunk device-memory gauges (no sync —
@@ -254,6 +289,7 @@ class ChunkedDetector:
             flags = self.feed(chunk)
             if telemetry is not None:
                 flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
+                self.emit_heartbeat(telemetry)
             elif metrics is not None:
                 self.record_memory_gauges(metrics)
             out.append(flags)  # async unless telemetry collected it above

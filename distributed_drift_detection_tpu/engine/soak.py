@@ -672,8 +672,14 @@ def run_soak_chained(
     ``telemetry`` (a :class:`..telemetry.events.EventLog`) emits one
     ``leg_completed`` progress event per leg — extracted from the leg's
     already-host-converted flag table, so multi-minute chains are visible
-    mid-flight from the persisted log. Same at-least-once semantics as
-    ``on_leg`` (events fire before the leg's checkpoint lands).
+    mid-flight from the persisted log — followed by one ``heartbeat``
+    (``rows_done`` = stream-absolute progress, checkpointed legs included,
+    so the ``watch`` CLI's percent/ETA survive a resume; ``elapsed_s`` =
+    monotonic seconds since THIS process started executing legs — watch
+    computes rates from heartbeat *deltas*, so the resumed-offset mismatch
+    between the two cannot inflate throughput). Same at-least-once
+    semantics as ``on_leg`` (events fire before the leg's checkpoint
+    lands).
 
     ``metrics`` (a :class:`..telemetry.metrics.MetricsRegistry`) records a
     per-leg device-memory snapshot (``device_bytes_in_use{when="leg"}``
@@ -785,6 +791,7 @@ def run_soak_chained(
             delays.append(np.asarray(meta["delays"], np.int64))
 
     start = time.perf_counter()
+    hb_start = time.monotonic()  # heartbeat clock: step-proof liveness
     out = None
     for s in range(start_leg, S):
         if s == 0:
@@ -812,6 +819,15 @@ def run_soak_chained(
             # included), so the legs sum to the summary's rows_processed.
             telemetry.emit(
                 "leg_completed", leg=s, rows=p * L * b, detections=int(hit.size)
+            )
+            # rows_done is stream-absolute ((s+1) whole legs, resumed ones
+            # included); elapsed is this process's monotonic span — see the
+            # docstring for why the pair is safe across resumes.
+            telemetry.emit(
+                "heartbeat",
+                rows_done=(s + 1) * p * L * b,
+                elapsed_s=time.monotonic() - hb_start,
+                leg=s,
             )
         if metrics is not None:
             from ..telemetry.profile import (

@@ -204,7 +204,12 @@ def run_grid(
     ``telemetry_dir`` gives every executed trial its own JSONL run log in
     that directory (telemetry subsystem) — the filename embeds the cell's
     config key, so a crashed sweep leaves per-cell evidence of where time
-    went and where drift fired, not just the missing CSV rows. Warm-up
+    went and where drift fired, not just the missing CSV rows. Each trial
+    additionally registers itself in the directory's ``index.jsonl``
+    (``telemetry.registry``, via ``api.run``: running → completed/failed),
+    and the sweep itself writes a bracketing ``kind="sweep"`` record with
+    its trial totals — so ``watch``/``report --dir`` and a post-mortem
+    both see the fleet state without parsing every log. Warm-up
     runs stay untelemetered (they are unrecorded by design).
 
     ``profile_dir`` wraps every executed trial's Final Time span in a
@@ -237,24 +242,58 @@ def run_grid(
         configs = kept
     todo = missing_configs(configs)
     progress(f"grid: {len(configs)} trials total, {len(todo)} to run")
-    warmed = None
-    for i, cfg in enumerate(todo):
-        static_key = (
-            cfg.dataset, cfg.mult_data, cfg.partitions, cfg.model,
-            cfg.detector, cfg.per_batch, cfg.window, cfg.window_rotations,
+
+    # Sweep-level registry bracket: the fleet view of "a sweep is running
+    # here, N trials to go" (per-trial records are api.run's job). A
+    # crashed sweep reads as status=failed next to however many per-trial
+    # records it got through — the registry equivalent of the idempotent
+    # resume the CSV already provides.
+    sweep_id = None
+    if telemetry_dir:
+        import time as _time
+
+        from ..telemetry import registry as run_registry
+
+        sweep_id = (
+            f"sweep-{_time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
         )
-        if warmup and static_key != warmed:
-            run(replace(cfg, results_csv="", time_string="warmup"))
-            warmed = static_key
-        if telemetry_dir:
-            cfg = replace(cfg, telemetry_dir=telemetry_dir)
-        if profile_dir:
-            cfg = replace(cfg, profile_dir=profile_dir)
-        res = run(cfg)
-        progress(
-            f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
-            f"time={res.total_time:.2f}s detections={res.metrics.num_detections} "
-            f"delay={res.metrics.mean_delay_rows:.1f} rows"
+        run_registry.record(
+            telemetry_dir, sweep_id, "running", kind="sweep",
+            trials_total=len(configs), trials_to_run=len(todo),
+        )
+    try:
+        warmed = None
+        for i, cfg in enumerate(todo):
+            static_key = (
+                cfg.dataset, cfg.mult_data, cfg.partitions, cfg.model,
+                cfg.detector, cfg.per_batch, cfg.window, cfg.window_rotations,
+            )
+            if warmup and static_key != warmed:
+                run(replace(cfg, results_csv="", time_string="warmup"))
+                warmed = static_key
+            if telemetry_dir:
+                cfg = replace(cfg, telemetry_dir=telemetry_dir)
+            if profile_dir:
+                cfg = replace(cfg, profile_dir=profile_dir)
+            res = run(cfg)
+            progress(
+                f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
+                f"time={res.total_time:.2f}s detections={res.metrics.num_detections} "
+                f"delay={res.metrics.mean_delay_rows:.1f} rows"
+            )
+    except BaseException:
+        if sweep_id is not None:
+            try:
+                run_registry.record(
+                    telemetry_dir, sweep_id, "failed", kind="sweep"
+                )
+            except Exception:
+                pass  # best-effort: the sweep's own error must surface
+        raise
+    if sweep_id is not None:
+        run_registry.record(
+            telemetry_dir, sweep_id, "completed", kind="sweep",
+            trials_run=len(todo),
         )
     return len(todo)
 

@@ -63,6 +63,89 @@ def _multiprocess_signalled() -> bool:
     return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
+# Launcher rank/world-size variables, most specific first: our own explicit
+# convention, then the cluster managers jax's own autodetection reads.
+_RANK_ENV = ("JAX_PROCESS_ID", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK")
+_WORLD_ENV = ("JAX_PROCESS_COUNT", "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE")
+
+
+def host_identity() -> dict:
+    """This process's fleet identity: ``{hostname, process_index,
+    process_count}`` — the ``run_started`` extras that let
+    ``telemetry.correlate`` merge one multi-host run's N per-process logs.
+
+    Jax-init-safe, same rule as :func:`initialize`: querying
+    ``jax.process_index()`` on a process that has not yet initialized a
+    backend would *create* one locally and make a later
+    ``jax.distributed.initialize`` impossible — an identity probe must
+    never decide the process's cluster fate. Resolution order:
+
+    1. The **distributed control plane**, when it is already up
+       (``jax.distributed.initialize`` ran): its process id/count are
+       authoritative and readable without touching any backend — this is
+       the pod window between ``multihost.initialize()`` and the first
+       device op, where a backend probe alone would misreport ``(0, 1)``.
+    2. A **live backend** (``jax.process_index()``), which at that point
+       is a harmless read.
+    3. The **launcher environment** (our explicit
+       ``JAX_PROCESS_ID``/``JAX_PROCESS_COUNT`` convention, else the
+       SLURM/OpenMPI rank variables jax's own cluster autodetection
+       reads), falling back to the single-process identity ``(0, 1)``.
+    """
+    import os
+    import socket
+
+    ident = {
+        "hostname": socket.gethostname(),
+        "process_index": 0,
+        "process_count": 1,
+    }
+    dist = _distributed_identity()
+    if dist is not None:
+        ident["process_index"], ident["process_count"] = dist
+        return ident
+    if _backend_initialized():
+        ident["process_index"] = int(jax.process_index())
+        ident["process_count"] = int(jax.process_count())
+        return ident
+    for key, names in (("process_index", _RANK_ENV),
+                       ("process_count", _WORLD_ENV)):
+        for var in names:
+            val = os.environ.get(var, "")
+            if val.strip().isdigit():
+                ident[key] = int(val)
+                break
+    return ident
+
+
+def _distributed_identity() -> "tuple[int, int] | None":
+    """``(process_id, num_processes)`` from jax's distributed runtime
+    state when the control plane is initialized, else ``None``. Reads the
+    private global state because there is no public backend-free probe;
+    an unknown internals layout reads as 'not initialized' so the probe
+    stays harmless."""
+    try:
+        from jax._src.distributed import global_state
+
+        if global_state.client is None:
+            return None
+        return int(global_state.process_id), int(global_state.num_processes)
+    except Exception:
+        return None
+
+
+def _backend_initialized() -> bool:
+    """Whether any XLA backend is already live in this process — without
+    creating one (same private-internals caveat as
+    :func:`_distributed_identity`)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
 def initialize(**kwargs) -> None:
     """Start the DCN control plane (single-process safe).
 

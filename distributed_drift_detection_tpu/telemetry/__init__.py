@@ -22,6 +22,19 @@ subsystem persists that answer as artifacts instead:
 * :mod:`.perf` — ``python -m distributed_drift_detection_tpu perf
   BENCH_r*.json``: per-cell diff of bench artifacts across rounds,
   nonzero exit on gated regressions beyond a tolerance.
+* :mod:`.registry` — append-only ``index.jsonl`` per telemetry dir:
+  run_id → config digest, status running/completed/failed, artifact
+  paths (written by ``api.run`` and the grid harness); the fleet's
+  "which runs exist here and did they finish".
+* :mod:`.correlate` — ``python -m distributed_drift_detection_tpu
+  correlate <dir|logs>``: merge one multi-host run's N per-process logs
+  into a single clock-skew-rebased timeline with straggler diagnostics
+  (per-host detect spread, throughput skew).
+* :mod:`.watch` — ``python -m distributed_drift_detection_tpu watch
+  <run.jsonl|dir>``: live-tail a run log (torn-tail tolerant), render
+  progress/ETA from ``heartbeat`` events, exit 3 when stalled past
+  ``--stall-after`` — the scriptable health check for CI and pod
+  launchers.
 
 Telemetry is **off by default** (``RunConfig.telemetry_dir=None``): every
 hook is an ``if log is not None`` guard outside the timed span, so the

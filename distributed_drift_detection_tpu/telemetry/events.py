@@ -48,6 +48,15 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "chunk_completed": ("chunk", "batches_done", "detections"),
     # soak progress: one per chained-soak leg (engine.soak.run_soak_chained)
     "leg_completed": ("leg", "rows", "detections"),
+    # liveness beacon for long streaming/soak runs (the `watch` CLI's food):
+    # ``rows_done`` = cumulative rows consumed so far, ``elapsed_s`` =
+    # monotonic seconds since the engine started feeding — monotonic, not
+    # wall-clock, so a host clock step mid-run cannot fake progress or a
+    # stall. Emitted host-side between device programs (per chunk / per
+    # leg), never from jitted code and never inside api.run's
+    # reference-parity Final Time span (api.run emits none: a one-shot run
+    # has no mid-flight to report).
+    "heartbeat": ("rows_done", "elapsed_s"),
     # XLA cost analysis of a compiled runner (telemetry.profile), extracted
     # host-side after the timed span. ``where`` names the program (e.g.
     # "detect_runner"); flops/bytes_accessed are None where the backend's
@@ -136,20 +145,32 @@ class EventLog:
         self._fh = open(path, "a")
 
     @classmethod
-    def open_run(cls, telemetry_dir: str, name: str = "") -> "EventLog":
+    def open_run(
+        cls,
+        telemetry_dir: str,
+        name: str = "",
+        process_index: "int | None" = None,
+    ) -> "EventLog":
         """Create the directory and a fresh per-run log file inside it.
 
         ``name`` (e.g. the resolved app name — the grid harness's per-cell
         config key) is sanitized into the filename; a timestamp + pid +
         process-local counter suffix keeps concurrent and repeated runs
-        from colliding.
+        from colliding. ``process_index`` (a ``jax.distributed`` process id,
+        see ``parallel.multihost.host_identity``) adds a ``procN`` segment:
+        in a multi-host run every process writes its own log into a shared
+        directory, and without the segment the N sibling logs of one run
+        are indistinguishable on disk (``telemetry.correlate`` groups them
+        by the ``run_started`` identity extras; the filename is for humans
+        and shell globs).
         """
         global _RUN_COUNTER
         os.makedirs(telemetry_dir, exist_ok=True)
         stem = _SAFE_NAME.sub("_", name).strip("_") or "run"
+        proc = "" if process_index is None else f"-proc{int(process_index)}"
         _RUN_COUNTER += 1
         fname = (
-            f"{stem}-{time.strftime('%Y%m%d-%H%M%S')}"
+            f"{stem}-{time.strftime('%Y%m%d-%H%M%S')}{proc}"
             f"-{os.getpid()}-{_RUN_COUNTER}.jsonl"
         )
         return cls(os.path.join(telemetry_dir, fname))
@@ -180,25 +201,40 @@ class EventLog:
         self.close()
 
 
-def read_events(path: str) -> list[dict]:
+def read_events(path: str, *, allow_partial_tail: bool = False) -> list[dict]:
     """Parse and schema-validate a run log; raises :class:`SchemaError` on
     any malformed line (the CI smoke gate's contract: a log that loads is a
-    log the report can render)."""
+    log the report can render).
+
+    ``allow_partial_tail=True`` tolerates exactly one **torn trailing
+    line** — the crash/live-tail read path. The sink appends
+    ``json.dumps(event) + "\\n"`` per emit, so a reader racing the writer
+    (or a log cut off by a crash/full volume mid-write) can see one final
+    line that is an incomplete JSON prefix; that line is skipped, never a
+    line before it (a malformed *interior* line is corruption either way),
+    and never a line that parses as JSON but violates the schema (a
+    complete-but-invalid event is a producer bug a tear cannot produce —
+    no strict prefix of the serialized object form is itself valid JSON).
+    The strict default is the CI smoke gate's contract.
+    """
     events = []
     with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                event = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise SchemaError(f"{path}:{lineno}: not JSON ({e})") from None
-            try:
-                validate_event(event)
-            except SchemaError as e:
-                raise SchemaError(f"{path}:{lineno}: {e}") from None
-            events.append(event)
+        lines = fh.readlines()
+    for lineno, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        try:
+            event = json.loads(stripped)
+        except json.JSONDecodeError as e:
+            if allow_partial_tail and lineno == len(lines):
+                break  # the one torn trailing line; everything before stands
+            raise SchemaError(f"{path}:{lineno}: not JSON ({e})") from None
+        try:
+            validate_event(event)
+        except SchemaError as e:
+            raise SchemaError(f"{path}:{lineno}: {e}") from None
+        events.append(event)
     return events
 
 

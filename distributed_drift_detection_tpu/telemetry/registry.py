@@ -1,0 +1,160 @@
+"""Run registry: an append-only ``index.jsonl`` per telemetry directory.
+
+The event logs answer "what did run X do"; the registry answers the fleet
+question that comes first — **which runs exist here, what configuration
+was each, and did it finish**. One telemetry directory (a grid sweep, a
+pod launch, a soak farm) accumulates one ``index.jsonl``: every record is
+a status transition ``{ts, run_id, status, ...}`` appended by the
+producer (``api.run`` around each telemetered run; ``harness.grid``
+around a sweep), so the index is a timeline of the directory's activity
+and the *latest* record per ``run_id`` is its current state:
+
+* ``running`` — carries the run's ``config_digest`` (stable SHA-256 of
+  the canonical config JSON: two runs with the same digest are the same
+  cell, the grid-comparison key), the log's filename, and any host
+  identity extras the producer adds.
+* ``completed`` / ``failed`` — terminal; ``failed`` is written by
+  ``api.run``'s exception path, so a crashed run is *recorded* as
+  crashed, not just absent (its partial event log is the evidence; the
+  registry is the pointer to it).
+
+Append-only JSONL, flushed per record, same crash posture as the event
+sink — and the same torn-tail tolerance on read (a record lost mid-write
+costs one status transition, never the index). Pure stdlib, no jax: the
+``watch``/``report``/``correlate`` CLIs read it wherever the artifacts
+land.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import time
+
+INDEX_NAME = "index.jsonl"
+
+# The only statuses the fold recognizes; producers writing anything else
+# fail loudly at append time, not at read time on another machine.
+STATUSES = ("running", "completed", "failed")
+
+
+def config_digest(config: dict) -> str:
+    """Stable short digest of a run configuration: canonical (sorted-key)
+    JSON, SHA-256, first 12 hex chars. Same config → same digest across
+    processes and sessions, so a multi-host run's N per-process records
+    (and a sweep's repeated trials of one cell) correlate by digest."""
+    canon = json.dumps(config, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()[:12]
+
+
+def index_path(telemetry_dir: str) -> str:
+    return os.path.join(telemetry_dir, INDEX_NAME)
+
+
+def record(telemetry_dir: str, run_id: str, status: str, **extras) -> dict:
+    """Append one status record; returns it. Creates the directory and
+    index on first use. ``extras`` ride along verbatim (``config_digest``,
+    ``log``, host identity, sweep totals, ...)."""
+    if status not in STATUSES:
+        raise ValueError(
+            f"unknown registry status {status!r}; expected one of {STATUSES}"
+        )
+    rec = {"ts": time.time(), "run_id": str(run_id), "status": status, **extras}
+    os.makedirs(telemetry_dir, exist_ok=True)
+    with open(index_path(telemetry_dir), "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+    return rec
+
+
+def read_index(telemetry_dir: str) -> list[dict]:
+    """All records in append order; ``[]`` when no index exists yet.
+    Torn-tail tolerant (a writer may be mid-append right now); an interior
+    malformed line is corruption and raises."""
+    path = index_path(telemetry_dir)
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as fh:
+        lines = fh.readlines()
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if lineno == len(lines):
+                break  # torn trailing record: one transition lost, not the index
+            raise ValueError(f"{path}:{lineno}: corrupt registry record")
+        if isinstance(rec, dict) and rec.get("run_id"):
+            records.append(rec)
+    return records
+
+
+def runs(telemetry_dir: str) -> dict[str, dict]:
+    """Fold the index into current state: ``run_id`` → latest record, with
+    ``started_ts`` preserved from the run's first record (the fold's one
+    derived field — 'newest run' means newest *start*, not newest status
+    flip: a week-old run failing now must not outrank today's)."""
+    out: dict[str, dict] = {}
+    for rec in read_index(telemetry_dir):
+        prev = out.get(rec["run_id"])
+        folded = dict(rec)
+        folded["started_ts"] = (
+            prev["started_ts"] if prev is not None else rec["ts"]
+        )
+        if prev is not None:  # status records may omit the start's extras
+            folded = {**prev, **folded}
+        out[rec["run_id"]] = folded
+    return out
+
+
+def newest_run_log(telemetry_dir: str) -> str | None:
+    """Resolve the directory's newest run log — the shared resolution
+    behind ``report --dir`` and ``watch <dir>``.
+
+    Registered runs are ranked by *start* time (the registry knows start
+    order exactly; a status flip on an old run must not outrank a newer
+    start). Logs the registry never heard of — producers driving
+    ``EventLog.open_run`` directly (streaming examples, the multihost
+    worker), or pre-registry artifacts — compete by mtime: a directory
+    mixing both must resolve to whichever run is actually newest, not to
+    whatever happens to be indexed. The index itself is never a
+    candidate."""
+    registered: set[str] = set()
+    best_reg: "tuple[float, str] | None" = None  # (recency, path)
+    for rec in runs(telemetry_dir).values():
+        log = rec.get("log")
+        if not log:
+            continue
+        registered.add(log)
+        path = os.path.join(telemetry_dir, log)
+        if not os.path.exists(path):
+            continue
+        # Recency = the later of start and last write: a long-lived run
+        # still appending must not lose to anything that merely happened
+        # after it *started*.
+        recency = max(rec["started_ts"], os.path.getmtime(path))
+        if best_reg is None or recency > best_reg[0]:
+            best_reg = (recency, path)
+    unregistered = [
+        p
+        for p in glob.glob(os.path.join(telemetry_dir, "*.jsonl"))
+        if os.path.basename(p) != INDEX_NAME
+        and os.path.basename(p) not in registered
+    ]
+    best_unreg: "tuple[float, str] | None" = None
+    if unregistered:
+        path = max(unregistered, key=os.path.getmtime)
+        best_unreg = (os.path.getmtime(path), path)
+    if best_reg is not None and best_unreg is not None:
+        # Both recencies are wall-clock stamps from the same host — the
+        # more recently alive run wins, registered or not.
+        return max(best_reg, best_unreg)[1]
+    for best in (best_reg, best_unreg):
+        if best is not None:
+            return best[1]
+    return None

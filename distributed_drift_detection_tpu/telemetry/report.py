@@ -1,6 +1,7 @@
 """Run-log report: render a persisted JSONL event log as a summary.
 
     python -m distributed_drift_detection_tpu report <run.jsonl>
+    python -m distributed_drift_detection_tpu report --dir <telemetry-dir>
 
 Answers the post-hoc questions the reference needs a re-run for: where the
 time went (phase breakdown), how fast it ran (throughput), what the
@@ -8,16 +9,22 @@ compiler said the detect program costs and how close the run came to it
 (cost/memory section: flops, bytes, peak temp allocation, achieved
 GFLOP/s — from the ``cost_analysis``/``memory_snapshot`` events), when and
 where drift fired (ascii timeline over the stream + per-partition counts),
-and — for streaming/soak logs — per-chunk/per-leg progress. Pure stdlib +
-the schema module; no jax, so it runs anywhere the artifact lands.
+and — for streaming/soak logs — per-chunk/per-leg progress. ``--dir``
+renders a telemetry directory's newest run (the registry-first resolution
+shared with the ``watch`` CLI — ``telemetry.registry.newest_run_log``),
+so "how did the latest run do" needs no filename archaeology. Pure
+stdlib + the schema module; no jax, so it runs anywhere the artifact
+lands.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .events import read_events
+from .registry import newest_run_log
 
 _TIMELINE_BINS = 50
 _TIMELINE_GLYPHS = " .:-=+*#%@"
@@ -36,6 +43,7 @@ def summarize(events: list[dict]) -> dict:
         "forced_retrains": 0,
         "chunks": [],
         "legs": [],
+        "heartbeat": None,
         "completed": None,
         "cost": None,
         "mem_analysis": None,
@@ -61,6 +69,8 @@ def summarize(events: list[dict]) -> dict:
             s["chunks"].append(e)
         elif t == "leg_completed":
             s["legs"].append(e)
+        elif t == "heartbeat":
+            s["heartbeat"] = e  # newest wins: the run's latest known pulse
         elif t == "cost_analysis":
             s["cost"] = e
         elif t == "memory_snapshot":
@@ -144,6 +154,14 @@ def render_report(events: list[dict]) -> str:
         )
     else:
         out.append("throughput <run incomplete: no run_completed event>")
+        hb = s["heartbeat"]
+        if hb is not None:
+            # An incomplete log with heartbeats: say how far it got (the
+            # live view is `watch`; this is the post-mortem of the pulse).
+            out.append(
+                f"progress   {int(hb['rows_done']):,} rows in "
+                f"{hb['elapsed_s']:.1f} s at last heartbeat"
+            )
 
     # Achieved vs available (telemetry.profile): what the compiler's cost
     # model says one runner execution is worth, against the detect phase's
@@ -207,7 +225,16 @@ def render_report(events: list[dict]) -> str:
         out.append(line)
 
     drifts = s["drifts"]
-    n_det = done["detections"] if done else len(drifts)
+    # Incomplete-log fallback: streaming engines report detections via
+    # their chunk/leg progress events, not per-drift events — sum whatever
+    # the log carries (a log has one producer, so these never overlap).
+    n_det = (
+        done["detections"]
+        if done
+        else len(drifts)
+        + sum(int(c["detections"] or 0) for c in s["chunks"])
+        + sum(int(leg["detections"]) for leg in s["legs"])
+    )
     out.append(f"detections {n_det}")
     if drifts:
         positions = [int(d["global_pos"]) for d in drifts]
@@ -262,12 +289,40 @@ def main(argv=None) -> None:
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("run_log", nargs="+", help="run-log *.jsonl path(s)")
+    ap.add_argument(
+        "run_log",
+        nargs="*",
+        help="run-log *.jsonl path(s); a directory renders its newest run",
+    )
+    ap.add_argument(
+        "--dir",
+        default=None,
+        metavar="DIR",
+        help="render a telemetry directory's newest run log (registry-"
+        "first resolution; falls back to newest *.jsonl by mtime)",
+    )
     args = ap.parse_args(argv)
-    for i, path in enumerate(args.run_log):
+
+    def resolve(p: str) -> str:
+        if not os.path.isdir(p):
+            return p
+        newest = newest_run_log(p)
+        if newest is None:
+            raise SystemExit(f"report: no run logs in {p}")
+        return newest
+
+    paths = [resolve(p) for p in args.run_log]
+    if args.dir is not None:
+        paths.append(resolve(args.dir))
+    if not paths:
+        ap.error("give run-log path(s) or --dir")
+    for i, path in enumerate(paths):
         if i:
             print()
-        print(render_report(read_events(path)))
+        # Torn-tail tolerant: a crashed or still-writing run is exactly
+        # what this post-mortem must render (strict validation is the CI
+        # smoke gate's separate read_events call, not this CLI).
+        print(render_report(read_events(path, allow_partial_tail=True)))
 
 
 if __name__ == "__main__":

@@ -23,10 +23,21 @@ Two data planes, selected by argv:
   and rebuilding global shape from per-host index planes is precisely the
   kind of code that works single-process and fails on a pod).
 
-argv: ``coordinator_address num_processes process_id [plain|packed]``.
+A third mode drives the *fleet observability* path (ISSUE 3) in anger:
+
+* ``telemetry`` — the plain data plane, plus each process writes its OWN
+  identified run log (``host_identity`` extras + ``procN`` filename) into
+  ``$DDD_FLEET_TELEMETRY_DIR``, with process 1 sleeping inside its timed
+  detect phase — the injected straggler the launching test's
+  ``telemetry.correlate`` merge must name.
+
+argv: ``coordinator_address num_processes process_id
+[plain|packed|telemetry]``.
 """
 
+import os
 import sys
+import time
 
 import numpy as np
 
@@ -76,7 +87,17 @@ def _packed_stream(c: int, f: int):
 
 def main(coord: str, nproc: int, pid: int, mode: str = "plain") -> None:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", DEVICES_PER_PROC)
+    try:
+        jax.config.update("jax_num_cpu_devices", DEVICES_PER_PROC)
+    except AttributeError:
+        # Older jax (< 0.5) has no jax_num_cpu_devices option; the XLA flag
+        # is read at backend init, which has not happened yet (same
+        # tolerance as tests/conftest.py).
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{DEVICES_PER_PROC}"
+        ).strip()
 
     from distributed_drift_detection_tpu.config import DDMParams
     from distributed_drift_detection_tpu.engine.loop import PackedIndexedBatches
@@ -98,10 +119,45 @@ def main(coord: str, nproc: int, pid: int, mode: str = "plain") -> None:
     # Identical stream on every host (same seed — the analog of every Spark
     # executor seeing the same upstream dataframe).
     c, f = 4, 6
-    build = {"plain": _plain_stream, "packed": _packed_stream}[mode]
+    build = {
+        "plain": _plain_stream,
+        "packed": _packed_stream,
+        "telemetry": _plain_stream,
+    }[mode]
     batches, window, packed = build(c, f)
     keys = jax.random.split(jax.random.key(0), PARTITIONS)
     model = build_model("centroid", ModelSpec(f, c))
+
+    # Fleet-observability mode: a per-process identified run log, with the
+    # identity coming from host_identity() — asserted against the launch
+    # topology, so the jax-init-safe probe is proven on a real
+    # process_count() > 1 control plane, not just monkeypatched.
+    tlog = None
+    if mode == "telemetry":
+        from distributed_drift_detection_tpu.parallel.multihost import (
+            host_identity,
+        )
+        from distributed_drift_detection_tpu.telemetry.events import EventLog
+
+        ident = host_identity()
+        assert ident["process_index"] == pid, ident
+        assert ident["process_count"] == nproc, ident
+        tlog = EventLog.open_run(
+            os.environ["DDD_FLEET_TELEMETRY_DIR"],
+            name="fleet_smoke",
+            process_index=ident["process_index"],
+        )
+        tlog.emit(
+            "run_started",
+            run_id=tlog.run_id,
+            config={  # identical across processes: the correlation key
+                "dataset": "multihost_worker:plain",
+                "model": "centroid",
+                "partitions": PARTITIONS,
+                "per_batch": PER_BATCH,
+            },
+            **ident,
+        )
 
     # --- the multi-host path under test ---
     mesh = multihost.global_mesh()
@@ -122,8 +178,18 @@ def main(coord: str, nproc: int, pid: int, mode: str = "plain") -> None:
     runner = make_mesh_runner(
         model, DDMParams(), mesh, shuffle=False, window=window, packed=packed
     )
+    t_detect = time.perf_counter()
     out = runner(db, dk)
     jax.block_until_ready(out)
+    if tlog is not None:
+        # Injected straggle: every process but 0 lags inside its timed
+        # detect phase, so the correlator has a real spread to diagnose.
+        time.sleep(1.5 * pid)
+        tlog.emit(
+            "phase_completed",
+            phase="detect",
+            seconds=time.perf_counter() - t_detect,
+        )
 
     # --- independent single-device reference inside this same process ---
     single = make_mesh_runner(
@@ -151,6 +217,15 @@ def main(coord: str, nproc: int, pid: int, mode: str = "plain") -> None:
             )
         checked += got.change_global.shape[0]
     assert checked == per_host, (checked, per_host)
+    if tlog is not None:
+        cg = np.asarray(expect_flags.change_global)
+        tlog.emit(
+            "run_completed",
+            rows=int(cg.shape[0] * (cg.shape[1] + 1) * PER_BATCH),
+            seconds=time.perf_counter() - t_detect,
+            detections=int((cg >= 0).sum()),
+        )
+        tlog.close()
     print(f"worker {pid}/{nproc} [{mode}]: OK ({checked} partitions checked)")
 
 

@@ -1,0 +1,658 @@
+"""Fleet observability (ISSUE 3): run registry, cross-host trace
+correlation, the live `watch` CLI with stall detection, heartbeat events,
+torn-tail reads, and the flag-event ordering the correlator depends on."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.telemetry import (
+    EventLog,
+    SchemaError,
+    emit_flag_events,
+    read_events,
+)
+from distributed_drift_detection_tpu.telemetry import registry
+from distributed_drift_detection_tpu.telemetry.correlate import (
+    CorrelationError,
+    correlate,
+    group_run_logs,
+    render_correlation,
+)
+from distributed_drift_detection_tpu.telemetry.watch import (
+    EXIT_NO_LOG,
+    EXIT_OK,
+    EXIT_STALLED,
+    LogTail,
+    WatchState,
+    watch,
+)
+
+# ---------------------------------------------------------------------------
+# read_events: torn-tail tolerance (crash / live-tail read path)
+# ---------------------------------------------------------------------------
+
+
+def _write_lines(path, lines):
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def _event_line(etype="phase_completed", seq=0, ts=0.0, **payload):
+    payload = payload or {"phase": "detect", "seconds": 1.0}
+    return json.dumps(
+        {"v": 1, "type": etype, "ts": ts, "seq": seq, **payload}
+    )
+
+
+def test_partial_tail_skips_exactly_one_torn_trailing_line(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    good = _event_line(seq=0)
+    torn = _event_line(seq=1)[:17]  # cut mid-object: invalid JSON prefix
+    _write_lines(path, [good, torn])
+    # strict default: the gate contract is unchanged
+    with pytest.raises(SchemaError, match="not JSON"):
+        read_events(path)
+    events = read_events(path, allow_partial_tail=True)
+    assert [e["seq"] for e in events] == [0]
+
+
+def test_partial_tail_never_skips_interior_or_invalid_lines(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    # torn INTERIOR line: corruption, not a tear — always raises
+    _write_lines(path, [_event_line(seq=0)[:17], _event_line(seq=1)])
+    with pytest.raises(SchemaError, match="not JSON"):
+        read_events(path, allow_partial_tail=True)
+    # complete-but-schema-invalid last line: producer bug, not a tear
+    _write_lines(
+        path, [_event_line(seq=0), json.dumps({"v": 1, "type": "nope"})]
+    )
+    with pytest.raises(SchemaError, match="unknown event type"):
+        read_events(path, allow_partial_tail=True)
+
+
+def test_open_run_embeds_process_index(tmp_path):
+    log = EventLog.open_run(str(tmp_path), name="x", process_index=3)
+    log.close()
+    assert "-proc3-" in os.path.basename(log.path)
+    log = EventLog.open_run(str(tmp_path), name="x")
+    log.close()
+    assert "-proc" not in os.path.basename(log.path)
+
+
+# ---------------------------------------------------------------------------
+# host identity (parallel.multihost.host_identity)
+# ---------------------------------------------------------------------------
+
+
+def test_host_identity_shape():
+    from distributed_drift_detection_tpu.parallel.multihost import (
+        host_identity,
+    )
+
+    ident = host_identity()
+    assert set(ident) == {"hostname", "process_index", "process_count"}
+    assert ident["hostname"]
+    assert ident["process_index"] == 0  # single-process test run
+    assert ident["process_count"] >= 1
+
+
+def test_host_identity_env_fallback_without_backend(monkeypatch):
+    from distributed_drift_detection_tpu.parallel import multihost
+
+    # The jax-init-safety contract: with no live backend the probe must not
+    # create one — identity comes from the launcher env, else (0, 1).
+    monkeypatch.setattr(multihost, "_backend_initialized", lambda: False)
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    monkeypatch.setenv("JAX_PROCESS_COUNT", "4")
+    ident = multihost.host_identity()
+    assert (ident["process_index"], ident["process_count"]) == (2, 4)
+    monkeypatch.setenv("JAX_PROCESS_ID", "bogus")
+    assert multihost.host_identity()["process_index"] == 0
+    # cluster-manager ranks (what jax's own autodetection reads) also work
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    monkeypatch.delenv("JAX_PROCESS_COUNT")
+    monkeypatch.setenv("SLURM_PROCID", "5")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    ident = multihost.host_identity()
+    assert (ident["process_index"], ident["process_count"]) == (5, 8)
+
+
+def test_host_identity_prefers_distributed_control_plane(monkeypatch):
+    # The pod window between jax.distributed.initialize() and the first
+    # device op: no backend exists yet, but the control plane knows the
+    # topology — it must win over both the backend probe and the env.
+    from distributed_drift_detection_tpu.parallel import multihost
+
+    # the real probe reports None in this single-process test run
+    assert multihost._distributed_identity() is None
+    monkeypatch.setattr(multihost, "_distributed_identity", lambda: (3, 8))
+    monkeypatch.setattr(multihost, "_backend_initialized", lambda: True)
+    ident = multihost.host_identity()
+    assert (ident["process_index"], ident["process_count"]) == (3, 8)
+
+
+# ---------------------------------------------------------------------------
+# run registry (telemetry.registry)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_round_trip_and_fold(tmp_path):
+    d = str(tmp_path)
+    registry.record(
+        d, "r1", "running", config_digest="abc", log="r1.jsonl",
+        process_index=0,
+    )
+    registry.record(d, "r2", "running", config_digest="def", log="r2.jsonl")
+    registry.record(d, "r1", "completed", rows=100)
+    recs = registry.read_index(d)
+    assert [r["run_id"] for r in recs] == ["r1", "r2", "r1"]
+    folded = registry.runs(d)
+    assert folded["r1"]["status"] == "completed"
+    # the terminal record inherits the start's extras and keeps started_ts
+    assert folded["r1"]["log"] == "r1.jsonl"
+    assert folded["r1"]["config_digest"] == "abc"
+    assert folded["r1"]["started_ts"] == recs[0]["ts"]
+    assert folded["r2"]["status"] == "running"
+    with pytest.raises(ValueError, match="unknown registry status"):
+        registry.record(d, "r3", "exploded")
+
+
+def test_registry_torn_tail_and_empty(tmp_path):
+    d = str(tmp_path)
+    assert registry.read_index(d) == []
+    registry.record(d, "r1", "running")
+    with open(registry.index_path(d), "a") as fh:
+        fh.write('{"ts": 1, "run_id": "r2", "status": "runn')  # torn append
+    assert [r["run_id"] for r in registry.read_index(d)] == ["r1"]
+    # interior corruption is NOT a tear
+    with open(registry.index_path(d), "a") as fh:
+        fh.write("\n" + json.dumps({"ts": 2, "run_id": "r3", "status": "running"}) + "\n")
+    with pytest.raises(ValueError, match="corrupt registry record"):
+        registry.read_index(d)
+
+
+def test_config_digest_canonical():
+    a = registry.config_digest({"model": "centroid", "seed": 0})
+    b = registry.config_digest({"seed": 0, "model": "centroid"})
+    assert a == b and len(a) == 12
+    assert a != registry.config_digest({"model": "centroid", "seed": 1})
+
+
+def _fake_run_log(tmp_path, name, t0, *, proc=0, nproc=1, config=None,
+                  detect_s=1.0, completed=True, extra=()):
+    """A synthetic per-process run log with a controllable clock: events at
+    t0, t0+1, ... — the correlate/watch fixtures' workhorse."""
+    ticks = iter(t0 + 0.5 * i for i in range(1000))
+    log = EventLog.open_run(
+        str(tmp_path), name=name, process_index=proc if nproc > 1 else None
+    )
+    log._clock = lambda: next(ticks)
+    log.emit(
+        "run_started",
+        run_id=log.run_id,
+        config=config or {"model": "centroid", "seed": 0},
+        hostname=f"host{proc}",
+        process_index=proc,
+        process_count=nproc,
+    )
+    for phase, secs in [("prepare", 0.2), ("detect", detect_s)]:
+        log.emit("phase_completed", phase=phase, seconds=secs)
+    for etype, payload in extra:
+        log.emit(etype, **payload)
+    if completed:
+        log.emit(
+            "run_completed",
+            rows=100_000,
+            seconds=detect_s + 0.2,
+            detections=7,
+        )
+    log.close()
+    return log.path
+
+
+# ---------------------------------------------------------------------------
+# newest-run resolution (shared by report --dir and watch <dir>)
+# ---------------------------------------------------------------------------
+
+
+def test_newest_run_log_recency_semantics(tmp_path):
+    d = str(tmp_path)
+    assert registry.newest_run_log(d) is None
+    old = _fake_run_log(tmp_path, "old", 1000.0)
+    new = _fake_run_log(tmp_path, "new", 2000.0)
+    # no index yet: mtime fallback — give the OLD log the newer mtime to
+    # prove the fallback really is mtime
+    now = time.time()
+    os.utime(old, (now + 60, now + 60))
+    os.utime(new, (now - 60, now - 60))
+    assert registry.newest_run_log(d) == old
+    # registered: recency = max(started, last write). With stale mtimes on
+    # both, registration order (b started after a) decides...
+    os.utime(old, (now - 3600, now - 3600))
+    registry.record(d, "a", "running", log=os.path.basename(old))
+    registry.record(d, "b", "running", log=os.path.basename(new))
+    assert registry.newest_run_log(d) == new
+    # ...but a registered run STILL BEING WRITTEN outranks a newer start —
+    # the live log is the one to watch, not the one that started last
+    os.utime(old, (now + 120, now + 120))
+    assert registry.newest_run_log(d) == old
+    # a registered-but-pruned log falls through to the survivor
+    os.utime(old, (now - 3600, now - 3600))
+    os.remove(new)
+    assert registry.newest_run_log(d) == old
+
+
+def test_newest_run_log_mixed_registered_and_unregistered(tmp_path):
+    # Producers that drive EventLog.open_run directly never register; a
+    # directory mixing both must resolve to whichever run is truly newest.
+    d = str(tmp_path)
+    reg = _fake_run_log(tmp_path, "registered", 100.0)
+    registry.record(d, "a", "running", log=os.path.basename(reg))
+    unreg = _fake_run_log(tmp_path, "unregistered", 200.0)
+    now = time.time()
+    os.utime(unreg, (now + 60, now + 60))  # written after `a` started
+    assert registry.newest_run_log(d) == unreg
+    os.utime(unreg, (now - 7 * 86400,) * 2)  # a week stale: registered wins
+    assert registry.newest_run_log(d) == reg
+
+
+# ---------------------------------------------------------------------------
+# cross-host correlation (telemetry.correlate)
+# ---------------------------------------------------------------------------
+
+
+def test_correlate_identifies_slower_host_across_clock_skew(tmp_path):
+    # Host clocks 5000 s apart (t0 offsets): correlation must rebase, not
+    # compare wall-clocks. Host 1's detect takes 2.5x host 0's.
+    a = _fake_run_log(tmp_path, "w", 1000.0, proc=0, nproc=2, detect_s=1.0)
+    b = _fake_run_log(tmp_path, "w", 6000.0, proc=1, nproc=2, detect_s=2.5)
+    corr = correlate([a, b])
+    assert [h["process_index"] for h in corr["hosts"]] == [0, 1]
+    st = corr["stragglers"]["detect"]
+    assert st["slowest"] == 1 and st["fastest"] == 0
+    assert st["spread_s"] == pytest.approx(1.5)
+    # every host's timeline starts at its own run_started: skew rebased
+    first_t = {
+        h: min(e["t"] for e in corr["timeline"] if e["host"] == h)
+        for h in (0, 1)
+    }
+    assert first_t == {0: 0.0, 1: 0.0}
+    out = render_correlation(corr)
+    assert "slowest proc1" in out and "fastest proc0" in out
+    assert "host1" in out
+
+
+def test_correlate_merged_timeline_deterministic(tmp_path):
+    a = _fake_run_log(tmp_path, "w", 1000.0, proc=0, nproc=2)
+    b = _fake_run_log(tmp_path, "w", 9000.0, proc=1, nproc=2)
+    one = correlate([a, b])
+    two = correlate([b, a])  # argument order must not matter
+    assert one["timeline"] == two["timeline"]
+    assert render_correlation(one) == render_correlation(two)
+    key = [(e["t"], e["host"], e["seq"]) for e in one["timeline"]]
+    assert key == sorted(key)
+
+
+def test_correlate_rejects_mixed_configs(tmp_path):
+    a = _fake_run_log(tmp_path, "w", 1000.0, config={"model": "centroid"})
+    b = _fake_run_log(tmp_path, "w", 2000.0, config={"model": "mlp"})
+    with pytest.raises(CorrelationError, match="different config digests"):
+        correlate([a, b])
+
+
+def test_correlate_rejects_two_runs_of_one_config(tmp_path):
+    # Same digest but a repeated process index: two successive runs of one
+    # cell, not one fleet — merging would interleave unrelated timelines.
+    a = _fake_run_log(tmp_path, "w", 1000.0, proc=0, nproc=2)
+    b = _fake_run_log(tmp_path, "w", 2000.0, proc=0, nproc=2)
+    with pytest.raises(CorrelationError, match="same process index"):
+        correlate([a, b])
+
+
+def test_group_run_logs_picks_newest_coherent_group(tmp_path):
+    cfg_old = {"model": "centroid", "seed": 0}
+    cfg_new = {"model": "centroid", "seed": 1}
+    _fake_run_log(tmp_path, "old", 100.0, proc=0, nproc=2, config=cfg_old)
+    _fake_run_log(tmp_path, "old", 100.0, proc=1, nproc=2, config=cfg_old)
+    new = [
+        _fake_run_log(tmp_path, "new", 500.0, proc=0, nproc=2, config=cfg_new),
+        _fake_run_log(tmp_path, "new", 505.0, proc=1, nproc=2, config=cfg_new),
+    ]
+    # the registry index in the dir must not confuse the grouper
+    registry.record(str(tmp_path), "sweep-1", "running", kind="sweep")
+    assert sorted(group_run_logs(str(tmp_path))) == sorted(new)
+    corr = correlate(group_run_logs(str(tmp_path)))
+    assert len(corr["hosts"]) == 2
+    assert corr["config"] == cfg_new
+
+
+def test_group_run_logs_rerun_of_older_config_wins(tmp_path):
+    # A re-run of config A groups WITH A's first run; the group must rank
+    # by its newest member, else this-morning's config B shadows the
+    # actually-newest A re-run.
+    cfg_a = {"model": "centroid", "seed": 0}
+    cfg_b = {"model": "mlp", "seed": 0}
+    _fake_run_log(tmp_path, "a1", 100.0, config=cfg_a)  # A, yesterday
+    _fake_run_log(tmp_path, "b", 500.0, config=cfg_b)  # B, this morning
+    rerun = _fake_run_log(tmp_path, "a2", 900.0, config=cfg_a)  # A, newest
+    assert group_run_logs(str(tmp_path)) == [rerun]
+
+
+def test_correlate_rate_is_resume_safe(tmp_path):
+    # A checkpoint-resumed soak host: rows_done is stream-absolute (50k
+    # resumed offset), elapsed_s is this-process. The single-beat ratio
+    # would claim 26,000 rows/s and name the FRESH host as straggler;
+    # deltas give the true 1,000 vs 2,000.
+    resumed = [
+        ("heartbeat", dict(rows_done=50_000 + 1000 * t, elapsed_s=float(t)))
+        for t in (1, 2)
+    ]
+    fresh = [
+        ("heartbeat", dict(rows_done=2000 * t, elapsed_s=float(t)))
+        for t in (1, 2)
+    ]
+    a = _fake_run_log(tmp_path, "w", 0.0, proc=0, nproc=2, completed=False,
+                      extra=resumed)
+    b = _fake_run_log(tmp_path, "w", 0.0, proc=1, nproc=2, completed=False,
+                      extra=fresh)
+    st = correlate([a, b])["stragglers"]["throughput"]
+    assert st["per_host"] == pytest.approx({0: 1000.0, 1: 2000.0})
+    assert st["slowest"] == 0
+    assert st["skew"] == pytest.approx(2.0)
+
+
+def test_correlate_throughput_skew_from_heartbeats(tmp_path):
+    beats = lambda rate: [  # noqa: E731 — tiny fixture builder
+        ("heartbeat", dict(rows_done=rate * t, elapsed_s=float(t)))
+        for t in (1, 2)
+    ]
+    a = _fake_run_log(
+        tmp_path, "w", 0.0, proc=0, nproc=2, completed=False,
+        extra=beats(1000),
+    )
+    b = _fake_run_log(
+        tmp_path, "w", 0.0, proc=1, nproc=2, completed=False,
+        extra=beats(250),
+    )
+    st = correlate([a, b])["stragglers"]["throughput"]
+    assert st["slowest"] == 1
+    assert st["skew"] == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------------
+# watch CLI (telemetry.watch)
+# ---------------------------------------------------------------------------
+
+
+def test_logtail_partial_line_tolerant(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    full = _event_line(seq=0, ts=1.0)
+    with open(path, "w") as fh:
+        fh.write(full + "\n" + _event_line(seq=1, ts=2.0)[:13])
+    tail = LogTail(path)
+    assert [e["seq"] for e in tail.poll()] == [0]
+    assert tail.poll() == []  # torn tail is not consumed...
+    with open(path, "a") as fh:
+        fh.write(_event_line(seq=1, ts=2.0)[13:] + "\n")
+    assert [e["seq"] for e in tail.poll()] == [1]  # ...and completes later
+
+
+def test_watch_state_progress_eta_and_delta_rate():
+    st = WatchState()
+    st.fold(
+        [
+            json.loads(_event_line("run_started", 0, 0.0, run_id="r",
+                                   config={"total_rows": 10_000})),
+            # resumed soak shape: rows_done stream-absolute, elapsed local —
+            # the single-beat ratio would claim 5000 rows/s
+            json.loads(_event_line("heartbeat", 1, 1.0, rows_done=5000,
+                                   elapsed_s=1.0)),
+            json.loads(_event_line("heartbeat", 2, 2.0, rows_done=6000,
+                                   elapsed_s=2.0)),
+        ]
+    )
+    assert st.rate() == pytest.approx(1000.0)  # delta rate, not 3000
+    line = st.status_line(now=3.0)
+    assert "rows 6,000/10,000 (60.0%)" in line
+    assert "1,000 rows/s" in line
+    assert "eta 4s" in line
+    assert "last heartbeat 1.0s ago" in line
+
+
+def _stalled_log(tmp_path, age_s=3600.0):
+    """A log whose last event is `age_s` old with no run_completed."""
+    return _fake_run_log(
+        tmp_path, "stalled", time.time() - age_s, completed=False
+    )
+
+
+def test_watch_once_exit_codes(tmp_path):
+    healthy = _fake_run_log(tmp_path / "ok", "ok", time.time() - 3600)
+    stalled = _stalled_log(tmp_path / "bad")
+    assert (
+        watch(healthy, once=True, stall_after=60, out=lambda *_: None)
+        == EXIT_OK  # completed: old but finished is healthy
+    )
+    assert (
+        watch(stalled, once=True, stall_after=60, out=lambda *_: None)
+        == EXIT_STALLED
+    )
+    # in-progress within the window: healthy so far
+    fresh = _fake_run_log(tmp_path / "live", "live", time.time() - 1,
+                          completed=False)
+    assert (
+        watch(fresh, once=True, stall_after=3600, out=lambda *_: None)
+        == EXIT_OK
+    )
+    assert (
+        watch(str(tmp_path / "nope"), once=True, out=lambda *_: None)
+        == EXIT_NO_LOG
+    )
+
+
+def test_watch_resolves_directory_to_newest_run(tmp_path):
+    older = _fake_run_log(tmp_path, "older", 100.0)
+    newest = _fake_run_log(tmp_path, "newer", 200.0)
+    now = time.time()  # pin mtimes: same-second creation must not tie
+    os.utime(older, (now - 60, now - 60))
+    os.utime(newest, (now, now))
+    lines = []
+    assert watch(str(tmp_path), once=True, out=lines.append) == EXIT_OK
+    assert lines[0] == f"watching {newest}"
+
+
+def test_watch_loop_detects_stall_then_completion(tmp_path):
+    path = _stalled_log(tmp_path, age_s=100.0)
+    fake_now = [time.time()]
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        fake_now[0] += s
+
+    rc = watch(
+        path, stall_after=150.0, interval=30.0,
+        clock=lambda: fake_now[0], sleep=sleep, out=lambda *_: None,
+    )
+    assert rc == EXIT_STALLED
+    assert len(sleeps) == 2  # polled until the age crossed 150 s
+    # the same log completing is detected and exits 0
+    with open(path, "a") as fh:
+        fh.write(
+            _event_line("run_completed", 99, time.time(), rows=1,
+                        seconds=1.0, detections=0) + "\n"
+        )
+    rc = watch(
+        path, stall_after=150.0, clock=lambda: fake_now[0],
+        sleep=sleep, out=lambda *_: None,
+    )
+    assert rc == EXIT_OK
+
+
+def test_watch_and_correlate_cli_entrypoints(tmp_path, capsys):
+    from distributed_drift_detection_tpu.__main__ import main as cli_main
+
+    path = _fake_run_log(tmp_path, "cli", 100.0)
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["watch", path, "--once", "--stall-after", "60"])
+    assert exc.value.code == EXIT_OK
+    assert "completed" in capsys.readouterr().out
+    cli_main(["correlate", str(tmp_path)])
+    assert "correlated 1 process log(s)" in capsys.readouterr().out
+    cli_main(["report", "--dir", str(tmp_path)])
+    assert "throughput" in capsys.readouterr().out
+
+
+def test_report_cli_renders_torn_log(tmp_path, capsys):
+    """The post-mortem CLI must render exactly the logs it exists for:
+    crashed or still-writing, torn final line included."""
+    from distributed_drift_detection_tpu.__main__ import main as cli_main
+
+    path = _fake_run_log(tmp_path, "torn", 100.0, completed=False)
+    with open(path, "a") as fh:
+        fh.write('{"v": 1, "type": "run_comp')  # crash mid-append
+    cli_main(["report", path])
+    out = capsys.readouterr().out
+    assert "run incomplete" in out
+
+
+def test_api_run_registers_and_completes_in_registry(tmp_path):
+    from distributed_drift_detection_tpu import RunConfig, run
+
+    d = str(tmp_path / "tele")
+    res = run(
+        RunConfig(
+            dataset="synth:rialto,seed=0", mult_data=1, partitions=2,
+            per_batch=50, model="centroid", results_csv="",
+            telemetry_dir=d,
+        )
+    )
+    folded = registry.runs(d)
+    (rec,) = folded.values()
+    assert rec["status"] == "completed"
+    assert rec["process_index"] == 0 and rec["process_count"] >= 1
+    assert rec["hostname"]
+    assert os.path.join(d, rec["log"]) == res.telemetry_path
+    assert registry.newest_run_log(d) == res.telemetry_path
+    # identity extras ride run_started; a one-shot run emits no heartbeat
+    events = read_events(res.telemetry_path)
+    started = events[0]
+    assert started["process_index"] == 0 and started["hostname"]
+    assert not any(e["type"] == "heartbeat" for e in events)
+
+
+def test_api_run_failure_is_recorded_as_failed(tmp_path):
+    from distributed_drift_detection_tpu import RunConfig, run
+
+    d = str(tmp_path / "tele")
+    with pytest.raises(FileNotFoundError):
+        run(
+            RunConfig(
+                dataset="/does/not/exist.csv", results_csv="",
+                telemetry_dir=d,
+            )
+        )
+    (rec,) = registry.runs(d).values()
+    assert rec["status"] == "failed"
+    assert rec["log"]  # the partial log is the evidence; registry points at it
+
+
+def test_api_run_failed_record_is_best_effort(tmp_path, monkeypatch):
+    """A registry append that fails on the crash path (e.g. the same full
+    volume that killed the run) must not mask the run's own exception."""
+    from distributed_drift_detection_tpu import RunConfig, run
+
+    orig = registry.record
+
+    def flaky(d, run_id, status, **kw):
+        if status == "failed":
+            raise OSError("telemetry volume full")
+        return orig(d, run_id, status, **kw)
+
+    monkeypatch.setattr(registry, "record", flaky)
+    with pytest.raises(FileNotFoundError):  # the run's error, not OSError
+        run(
+            RunConfig(
+                dataset="/does/not/exist.csv", results_csv="",
+                telemetry_dir=str(tmp_path / "tele"),
+            )
+        )
+
+
+def test_grid_sweep_writes_registry_bracket(tmp_path):
+    from distributed_drift_detection_tpu.config import RunConfig
+    from distributed_drift_detection_tpu.harness.grid import run_grid
+
+    d = str(tmp_path / "tele")
+    base = RunConfig(
+        dataset="synth:rialto,seed=0", per_batch=50, model="centroid",
+        results_csv=str(tmp_path / "res.csv"),
+    )
+    n = run_grid(
+        base, mults=[1.0], partitions=[2], trials=1,
+        progress=lambda *_: None, telemetry_dir=d,
+    )
+    assert n == 1
+    folded = registry.runs(d)
+    sweeps = [r for r in folded.values() if r.get("kind") == "sweep"]
+    trials = [r for r in folded.values() if r.get("kind") != "sweep"]
+    assert len(sweeps) == 1 and sweeps[0]["status"] == "completed"
+    assert sweeps[0]["trials_total"] == 1 and sweeps[0]["trials_run"] == 1
+    assert len(trials) == 1 and trials[0]["status"] == "completed"
+
+
+# ---------------------------------------------------------------------------
+# emit_flag_events ordering: the property the correlator leans on
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", range(25))
+def test_emit_flag_events_column_major_property(tmp_path, case):
+    """Property test (seeded random tables — hypothesis is not available in
+    every supported environment): the emitted drift/retrain timelines are
+    column-major (batch ascending, partition ascending within a batch),
+    ``batch`` is the 1-based flag-table column, delays are ``pos % dist``
+    (None without geometry), and ``forced`` mirrors the forced_retrain
+    table exactly — the order the correlator's merged timeline inherits."""
+    rng = np.random.default_rng(1234 + case)
+    p = int(rng.integers(1, 6))
+    nb = int(rng.integers(1, 9))
+    dist = int(rng.choice([0, 100, 517]))
+    changed = rng.random((p, nb)) < 0.3
+    cg = np.where(changed, rng.integers(0, 10_000, (p, nb)), -1)
+    fr = rng.random((p, nb)) < 0.2
+
+    log = EventLog(str(tmp_path / f"flags{case}.jsonl"))
+    with log:
+        n = emit_flag_events(log, cg, fr, dist)
+    events = read_events(log.path)
+    drifts = [e for e in events if e["type"] == "drift_detected"]
+    retrains = [e for e in events if e["type"] == "retrain"]
+
+    assert n == len(drifts) == int(changed.sum())
+    # drift events first, then retrains — each internally column-major
+    assert [e["type"] for e in events] == (
+        ["drift_detected"] * len(drifts) + ["retrain"] * len(retrains)
+    )
+    for group in (drifts, retrains):
+        key = [(e["batch"], e["partition"]) for e in group]
+        assert key == sorted(key), "timeline must be batch-then-partition"
+    # batch = column + 1 and delay semantics per drift
+    for e in drifts:
+        b, q = e["batch"] - 1, e["partition"]
+        assert changed[q, b]
+        assert e["global_pos"] == int(cg[q, b])
+        expect = (int(cg[q, b]) % dist) if dist > 0 else None
+        assert e["delay_rows"] == expect
+    # retrains cover changed | forced, with the forced flag verbatim
+    expect_rt = sorted(
+        (int(b) + 1, int(q))
+        for q, b in zip(*np.nonzero(changed | fr))
+    )
+    assert [(e["batch"], e["partition"]) for e in retrains] == expect_rt
+    for e in retrains:
+        assert e["forced"] == bool(fr[e["partition"], e["batch"] - 1])

@@ -37,7 +37,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(nproc: int, timeout: int = 420, mode: str = "plain") -> list:
+def _launch(
+    nproc: int,
+    timeout: int = 420,
+    mode: str = "plain",
+    extra_env: dict | None = None,
+) -> list:
     coord = f"127.0.0.1:{_free_port()}"
     from distributed_drift_detection_tpu.utils.hermetic import hermetic_cpu_env
 
@@ -47,6 +52,7 @@ def _launch(nproc: int, timeout: int = 420, mode: str = "plain") -> list:
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo_root, env.get("PYTHONPATH")) if p
     )
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, coord, str(nproc), str(pid), mode],
@@ -98,3 +104,37 @@ def test_multiprocess_flags_match_single_device(nproc, mode):
     for pid, (rc, out) in enumerate(outs):
         assert rc == 0, f"worker {pid}/{nproc} [{mode}] failed:\n{out[-4000:]}"
         assert f"worker {pid}/{nproc} [{mode}]: OK" in out, out[-2000:]
+
+
+def test_two_process_correlate_smoke(tmp_path):
+    """Fleet-observability smoke with a REAL process_count() == 2 control
+    plane (ISSUE 3 CI criterion): each process writes its own identified
+    run log; the merged timeline is deterministic (input order must not
+    matter), and the correlator names the injected straggler — process 1
+    sleeps 1.5 s inside its timed detect phase."""
+    from distributed_drift_detection_tpu.telemetry.correlate import (
+        correlate,
+        group_run_logs,
+        render_correlation,
+    )
+
+    tdir = str(tmp_path / "fleet")
+    outs = _launch(
+        2, mode="telemetry", extra_env={"DDD_FLEET_TELEMETRY_DIR": tdir}
+    )
+    for pid, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {pid}/2 [telemetry] failed:\n{out[-4000:]}"
+
+    paths = group_run_logs(tdir)
+    assert len(paths) == 2, paths
+    one = correlate(paths)
+    two = correlate(list(reversed(paths)))
+    assert one["timeline"] == two["timeline"]  # deterministic merge
+    assert render_correlation(one) == render_correlation(two)
+    assert [h["process_index"] for h in one["hosts"]] == [0, 1]
+    assert {h["hostname"] for h in one["hosts"]}  # identity extras present
+
+    st = one["stragglers"]["detect"]
+    assert st["slowest"] == 1, st  # the injected sleep
+    assert st["spread_s"] > 0.5, st
+    assert "slowest proc1" in render_correlation(one)

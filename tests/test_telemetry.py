@@ -36,6 +36,7 @@ EXAMPLE_EVENTS = {
     "retrain": dict(partition=0, batch=7, forced=True),
     "chunk_completed": dict(chunk=2, batches_done=256, detections=4),
     "leg_completed": dict(leg=1, rows=100_000, detections=9),
+    "heartbeat": dict(rows_done=3_200_000, elapsed_s=12.5),
     "cost_analysis": dict(
         where="detect_runner", flops=1.57e8, bytes_accessed=1.89e8
     ),
@@ -465,15 +466,25 @@ def test_chunked_detector_emits_chunk_events(tmp_path):
         np.asarray(plain.change_global), np.asarray(flags.change_global)
     )
     events = read_events(log.path)
-    assert all(e["type"] == "chunk_completed" for e in events)
+    chunks = [e for e in events if e["type"] == "chunk_completed"]
+    beats = [e for e in events if e["type"] == "heartbeat"]
+    assert {e["type"] for e in events} == {"chunk_completed", "heartbeat"}
     n_chunks = -(-len(y) // (p * b * cb))
-    assert [e["chunk"] for e in events] == list(range(n_chunks))
-    assert sum(e["detections"] for e in events) == int(
+    assert [e["chunk"] for e in chunks] == list(range(n_chunks))
+    assert sum(e["detections"] for e in chunks) == int(
         (np.asarray(flags.change_global) >= 0).sum()
     )
-    assert events[-1]["batches_done"] == int(
+    assert chunks[-1]["batches_done"] == int(
         np.asarray(flags.change_global).shape[1]
     )
+    # one liveness beacon per chunk: rows fed (seed batch included) grow
+    # monotonically to the full stream, on a monotonic clock
+    assert len(beats) == n_chunks
+    rows_done = [e["rows_done"] for e in beats]
+    assert rows_done == sorted(rows_done)
+    assert rows_done[-1] == n_chunks * p * b * cb  # padded chunk geometry
+    elapsed = [e["elapsed_s"] for e in beats]
+    assert all(b2 >= b1 >= 0 for b1, b2 in zip(elapsed, elapsed[1:]))
 
 
 def test_soak_chained_emits_leg_events(tmp_path):
@@ -488,10 +499,21 @@ def test_soak_chained_emits_leg_events(tmp_path):
             drift_every=500, max_leg_rows=2000, telemetry=log,
         )
     events = read_events(log.path)
-    assert [e["type"] for e in events] == ["leg_completed"] * s.legs
+    assert [e["type"] for e in events] == (
+        ["leg_completed", "heartbeat"] * s.legs
+    )
+    legs = [e for e in events if e["type"] == "leg_completed"]
     assert s.legs >= 2  # max_leg_rows forced a real chain
-    assert sum(e["rows"] for e in events) == s.rows_processed
-    assert sum(e["detections"] for e in events) == s.detections
+    assert sum(e["rows"] for e in legs) == s.rows_processed
+    assert sum(e["detections"] for e in legs) == s.detections
+    # heartbeat rows_done is stream-absolute: the last beat covers the
+    # whole chain, each beat the legs completed so far
+    beats = [e for e in events if e["type"] == "heartbeat"]
+    per_leg = s.rows_processed // s.legs
+    assert [e["rows_done"] for e in beats] == [
+        (i + 1) * per_leg for i in range(s.legs)
+    ]
+    assert beats[-1]["rows_done"] == s.rows_processed
 
 
 # ---------------------------------------------------------------------------
