@@ -19,13 +19,14 @@ run's telemetry artifacts; mutually exclusive with ``--trace-dir``), and
 ``--telemetry-dir DIR`` persists the structured JSONL run log + metric
 exports (telemetry subsystem).
 
-Four further subcommands work offline (no accelerator, no data — just the
-artifacts):
+Five further subcommands work offline (no accelerator, no data — just the
+artifacts; ``heal --execute`` is the one that runs experiments):
 
     python -m distributed_drift_detection_tpu report <run.jsonl | --dir DIR>
     python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
+    python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
 
 ``report`` renders a persisted run log (``--dir`` picks a telemetry
 directory's newest run); ``perf`` diffs bench artifacts across rounds per
@@ -34,7 +35,10 @@ cell and exits nonzero on gated regressions beyond a tolerance
 heartbeats, exit 3 past ``--stall-after`` (telemetry.watch, the
 scriptable health check); ``correlate`` merges a multi-host run's
 per-process logs into one timeline with straggler diagnostics
-(telemetry.correlate).
+(telemetry.correlate); ``heal`` diffs a sweep spec against the
+registry's completed runs and emits — or ``--execute``s under the
+retry supervisor — the re-run plan for whatever a crash left missing
+(resilience.heal; plan mode is jax-free, exit 0 = sweep whole).
 """
 
 import sys
@@ -46,7 +50,8 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
-    "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS"
+    "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
+    "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR"
 )
 
 
@@ -89,6 +94,12 @@ def main(argv: list[str]) -> None:
         from .telemetry.correlate import main as correlate_main
 
         correlate_main(argv[1:])
+        return
+    if argv and argv[0] == "heal":
+        # jax-free in plan mode; --execute pulls in the api lazily.
+        from .resilience.heal import main as heal_main
+
+        heal_main(argv[1:])
         return
 
     argv = list(argv)

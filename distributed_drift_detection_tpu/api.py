@@ -44,6 +44,7 @@ from .config import (
     host_shuffle_seed,
     replace,
     resolve_retrain_threshold,
+    telemetry_config_payload,
 )
 from .engine.loop import FlagRows
 from .io.stream import (
@@ -65,6 +66,7 @@ from .parallel.mesh import (
     shard_batches,
     unpack_flags,
 )
+from .resilience import faults
 from .results import append_result
 from .utils.timing import PhaseTimer, maybe_trace
 
@@ -289,18 +291,10 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     # status=failed in index.jsonl, not as an unexplained absence.
     try:
         if log is not None:
-            config_payload = {
-                "dataset": str(cfg.dataset),
-                "model": cfg.model,
-                "detector": cfg.detector,
-                "partitions": cfg.partitions,
-                "per_batch": cfg.per_batch,
-                "mult_data": cfg.mult_data,
-                "seed": cfg.seed,
-                "backend": cfg.backend,
-                "window": cfg.window,  # 0 = auto; resolved rides on
-                "window_rotations": cfg.window_rotations,  # compile event
-            }
+            # Shared with resilience.heal: the heal planner recomputes
+            # these digests from a sweep spec, so the field set lives in
+            # one place (config.telemetry_config_payload).
+            config_payload = telemetry_config_payload(cfg)
             log.emit(
                 "run_started", run_id=log.run_id, config=config_payload,
                 **ident,
@@ -313,6 +307,11 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
                 log=os.path.basename(log.path),
                 **ident,
             )
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # a whole-run crash inside the registry bracket, so the failed
+        # record + partial log land exactly as a real crash would leave
+        # them — what the supervised-retry and heal tests exercise.
+        faults.fire("api.run", run_id=None if log is None else log.run_id)
         with timer.phase("prepare"):
             prep = prepare(cfg, stream)
         stream, batches, runner, keys, mesh = (

@@ -404,6 +404,37 @@ def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
     return dataclasses.replace(cfg, **kw)
 
 
+def telemetry_config_payload(cfg: RunConfig) -> dict:
+    """The config dict ``api.run`` emits in ``run_started`` and digests
+    into the registry (``telemetry.registry.config_digest``).
+
+    Single source of truth shared with ``resilience.heal``: the heal
+    planner recomputes every expected trial's digest from its spec, and
+    a drifted field set would make completed trials read as missing (or
+    worse, missing ones as completed). ``window``/``window_rotations``
+    are the *requested* values (0 = auto, resolved later by prepare);
+    bookkeeping fields (time_string, telemetry_dir, ...) stay out — two
+    runs of the same experiment must share a digest.
+
+    Values are type-normalized (``mult_data`` → float, counts → int):
+    JSON renders ``1`` and ``1.0`` differently, so without this a sweep
+    launched with integer mults and a heal planner normalizing to float
+    would digest the *same cell* two ways and re-run completed work.
+    """
+    return {
+        "dataset": str(cfg.dataset),
+        "model": cfg.model,
+        "detector": cfg.detector,
+        "partitions": int(cfg.partitions),
+        "per_batch": int(cfg.per_batch),
+        "mult_data": float(cfg.mult_data),
+        "seed": int(cfg.seed),
+        "backend": cfg.backend,
+        "window": int(cfg.window),
+        "window_rotations": int(cfg.window_rotations),
+    }
+
+
 # Version of the auto W×R resolution policy (auto_window / auto_rotations).
 # Bump whenever the resolution *algorithm* changes (v2 = the r04 co-resolved
 # depth-4 policy): grid trial keys embed it for auto-mode configs

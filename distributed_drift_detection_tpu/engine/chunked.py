@@ -187,6 +187,13 @@ class ChunkedDetector:
         """
         import time
 
+        from ..resilience import faults
+
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # "raise at batch K" at this engine's host granularity — the K-th
+        # fed chunk — before any state advances, so a killed-and-resumed
+        # stream replays from its last checkpoint exactly.
+        faults.fire("chunked.feed", batches_done=self.batches_done)
         if self._feed_started is None:
             self._feed_started = time.monotonic()
         self.rows_done += int(

@@ -790,10 +790,17 @@ def run_soak_chained(
         if meta["delays"]:
             delays.append(np.asarray(meta["delays"], np.int64))
 
+    from ..resilience import faults
+
     start = time.perf_counter()
     hb_start = time.monotonic()  # heartbeat clock: step-proof liveness
     out = None
     for s in range(start_leg, S):
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # kill the chain before leg `s` executes — the kill-and-resume
+        # tests arm this to prove a resumed chain's flags are bit-
+        # identical to an uninterrupted run's.
+        faults.fire("soak.leg", leg=s)
         if s == 0:
             out = first_c(key, impl.block0s)
         else:
@@ -839,9 +846,11 @@ def run_soak_chained(
                 metrics, device_memory_stats(), when="leg"
             )
         if checkpoint_path:
-            tmp = checkpoint_path + ".tmp"
+            # save_checkpoint is atomic (same-dir temp + os.replace +
+            # fsync — utils.checkpoint), so a crash mid-save can tear
+            # only the temp file, never the last good checkpoint.
             save_checkpoint(
-                tmp,
+                checkpoint_path,
                 out.state,
                 meta={
                     **geometry,
@@ -850,7 +859,6 @@ def run_soak_chained(
                     "delays": np.concatenate(delays).tolist() if delays else [],
                 },
             )
-            os.replace(tmp, checkpoint_path)
     exec_time = time.perf_counter() - start
     if checkpoint_path and os.path.exists(checkpoint_path):
         os.remove(checkpoint_path)

@@ -32,7 +32,25 @@ from ..config import (
     replace,
     resolve_retrain_threshold,
 )
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
 from ..results import read_results
+
+# Sweep-shape defaults, shared between this CLI's argparse flags and the
+# heal subsystem's sweep-spec schema (resilience.heal._SPEC_DEFAULTS is
+# this dict): a spec that omits a knob must expand to the SAME configs the
+# grid CLI ran with the flag omitted, or the config digests drift and heal
+# re-runs (or wrongly skips) completed trials. `seed` is the RunConfig
+# default (the grid CLI exposes no flag for it).
+SWEEP_DEFAULTS = {
+    "models": ["centroid"],
+    "detectors": ["ddm"],
+    "trials": 5,
+    "per_batch": 100,
+    "seed": 0,
+    "results_csv": "ddm_cluster_runs.csv",
+    "spec": "warn",
+}
 
 
 def grid_configs(
@@ -151,9 +169,13 @@ def off_spec_reason(cfg: RunConfig) -> str | None:
 
 def completed_trials(results_csv: str) -> Counter:
     """Count completed trials per config key from the results CSV (the C13
-    trial count / C14 missing-trial detection, done on live data)."""
+    trial count / C14 missing-trial detection, done on live data).
+
+    Torn-tail tolerant: a sweep killed mid-append leaves at most one
+    partial trailing row, and the resume that healing exists for must not
+    choke on exactly the artifact a crash produces."""
     try:
-        rows = read_results(results_csv)
+        rows = read_results(results_csv, allow_partial_tail=True)
     except FileNotFoundError:
         return Counter()
     return Counter(r["Spark App"] for r in rows)
@@ -186,6 +208,9 @@ def run_grid(
     spec: str = "warn",
     telemetry_dir: str = "",
     profile_dir: str = "",
+    retries: int = 0,
+    timeout_s: float | None = None,
+    on_error: str = "fail",
 ) -> int:
     """Run all missing trials of the sweep; returns number executed.
 
@@ -218,11 +243,38 @@ def run_grid(
     perturbs the very Final Times the grid records, so use it on
     diagnostic sweeps, not the 5-trial result grids. Warm-ups stay
     unprofiled, like telemetry.
+
+    Resilience wiring (``resilience`` subsystem): every trial runs under
+    the supervisor — ``retries`` transient-failure re-runs per cell with
+    deterministic seeded backoff and ``timeout_s`` per-attempt wall-clock
+    budget (``RetryPolicy``; with ``retries=0`` and no timeout the
+    supervisor is a plain call plus the registry ``attempt`` bracket).
+    ``on_error='continue'`` keeps sweeping past a cell whose attempts all
+    failed: remaining cells run, each failure is reported via
+    ``progress``, the sweep's registry record ends ``failed`` with the
+    per-cell evidence next to it, and a summary ``RuntimeError`` is
+    raised at the end (re-run the grid, or ``heal --execute``, to finish
+    the sweep). The default ``'fail'`` stops at the first failed cell,
+    matching the reference's crash behaviour. ``run_grid`` also arms any
+    fault sites requested via the ``DDD_FAULTS`` env var
+    (``resilience.faults.arm_from_env``) — inert unless set.
     """
     if spec not in ("warn", "skip", "off"):
         raise ValueError(f"spec must be 'warn', 'skip' or 'off', got {spec!r}")
+    if on_error not in ("fail", "continue"):
+        raise ValueError(
+            f"on_error must be 'fail' or 'continue', got {on_error!r}"
+        )
 
     from ..api import run  # lazy: keeps harness importable without jax init
+    from ..resilience.supervisor import supervise
+
+    armed = faults.arm_from_env()
+    if armed:
+        progress(f"grid: fault site(s) armed from DDD_FAULTS: {armed}")
+    policy = RetryPolicy(
+        max_attempts=max(retries, 0) + 1, timeout_s=timeout_s, seed=base.seed
+    )
 
     configs = grid_configs(base, mults, partitions, models, trials, detectors)
     if spec != "off":
@@ -261,6 +313,7 @@ def run_grid(
             telemetry_dir, sweep_id, "running", kind="sweep",
             trials_total=len(configs), trials_to_run=len(todo),
         )
+    failures: list[tuple[str, Exception]] = []
     try:
         warmed = None
         for i, cfg in enumerate(todo):
@@ -268,16 +321,45 @@ def run_grid(
                 cfg.dataset, cfg.mult_data, cfg.partitions, cfg.model,
                 cfg.detector, cfg.per_batch, cfg.window, cfg.window_rotations,
             )
-            if warmup and static_key != warmed:
-                run(replace(cfg, results_csv="", time_string="warmup"))
-                warmed = static_key
             if telemetry_dir:
                 cfg = replace(cfg, telemetry_dir=telemetry_dir)
             if profile_dir:
                 cfg = replace(cfg, profile_dir=profile_dir)
-            res = run(cfg)
+            key = cfg.resolved_app_name()
+
+            # The fault site lives INSIDE the supervised attempt, so a
+            # positional arming (`at=K`) fires once and the retry heals
+            # it — the deterministic stand-in for a transient crash.
+            def attempt(cfg=cfg, i=i, key=key):
+                faults.fire("grid.cell", index=i, key=key)
+                return run(cfg)
+
+            try:
+                # The warm-up runs OUTSIDE the supervised attempt: it must
+                # not be charged against the per-attempt timeout budget or
+                # repeated per retry (its whole point is once per config
+                # block). Unrecorded on every axis: no CSV row, no
+                # telemetry log/registry record, no profile capture.
+                if warmup and static_key != warmed:
+                    run(replace(
+                        cfg, results_csv="", time_string="warmup",
+                        telemetry_dir=None, profile_dir="",
+                    ))
+                    warmed = static_key
+                res = supervise(
+                    attempt, policy, telemetry_dir=telemetry_dir, name=key
+                )
+            except Exception as exc:
+                if on_error != "continue":
+                    raise
+                failures.append((key, exc))
+                progress(
+                    f"[{i + 1}/{len(todo)}] {key}: FAILED "
+                    f"({type(exc).__name__}: {exc}) — continuing"
+                )
+                continue
             progress(
-                f"[{i + 1}/{len(todo)}] {cfg.resolved_app_name()}: "
+                f"[{i + 1}/{len(todo)}] {key}: "
                 f"time={res.total_time:.2f}s detections={res.metrics.num_detections} "
                 f"delay={res.metrics.mean_delay_rows:.1f} rows"
             )
@@ -292,8 +374,19 @@ def run_grid(
         raise
     if sweep_id is not None:
         run_registry.record(
-            telemetry_dir, sweep_id, "completed", kind="sweep",
-            trials_run=len(todo),
+            telemetry_dir, sweep_id,
+            "failed" if failures else "completed", kind="sweep",
+            trials_run=len(todo) - len(failures),
+            trials_failed=len(failures),
+        )
+    if failures:
+        # The sweep finished its schedule but is not whole: fail loudly
+        # with the evidence pointer instead of returning a count that
+        # reads as success (on_error='fail' never reaches here).
+        raise RuntimeError(
+            f"{len(failures)} of {len(todo)} trials failed "
+            f"({', '.join(k for k, _ in failures)}); the registry/CSV have "
+            "the evidence — re-run the grid or `heal --execute` to finish"
         )
     return len(todo)
 
@@ -303,11 +396,11 @@ def main(argv=None) -> None:
     ap.add_argument("--dataset", default="/root/reference/outdoorStream.csv")
     ap.add_argument("--mults", default="1,2,4")
     ap.add_argument("--partitions", default="1,2,4,8")
-    ap.add_argument("--models", default="centroid")
-    ap.add_argument("--detectors", default="ddm")
-    ap.add_argument("--trials", type=int, default=5)
-    ap.add_argument("--per-batch", type=int, default=100)
-    ap.add_argument("--results-csv", default="ddm_cluster_runs.csv")
+    ap.add_argument("--models", default=",".join(SWEEP_DEFAULTS["models"]))
+    ap.add_argument("--detectors", default=",".join(SWEEP_DEFAULTS["detectors"]))
+    ap.add_argument("--trials", type=int, default=SWEEP_DEFAULTS["trials"])
+    ap.add_argument("--per-batch", type=int, default=SWEEP_DEFAULTS["per_batch"])
+    ap.add_argument("--results-csv", default=SWEEP_DEFAULTS["results_csv"])
     ap.add_argument(
         "--warmup",
         action="store_true",
@@ -316,7 +409,7 @@ def main(argv=None) -> None:
     )
     ap.add_argument(
         "--spec",
-        default="warn",
+        default=SWEEP_DEFAULTS["spec"],
         choices=["warn", "skip", "off"],
         help="notebook grid-validity rule (off_spec_reason): warn on "
         "off-spec (dataset, mult, partitions) cells, skip them, or disable "
@@ -336,6 +429,26 @@ def main(argv=None) -> None:
         "under this directory (perturbs the recorded Final Times — "
         "diagnostic sweeps only; see run_grid)",
     )
+    ap.add_argument(
+        "--retries",
+        type=int,
+        default=0,
+        help="supervised re-runs per trial on transient failure "
+        "(resilience.RetryPolicy; deterministic seeded backoff)",
+    )
+    ap.add_argument(
+        "--timeout-s",
+        type=float,
+        default=0.0,
+        help="per-attempt wall-clock budget in seconds (0 = unlimited)",
+    )
+    ap.add_argument(
+        "--continue-on-error",
+        action="store_true",
+        help="keep sweeping past a failed cell; the sweep exits nonzero "
+        "at the end with the failed cells listed (heal --execute or a "
+        "re-run finishes it)",
+    )
     args = ap.parse_args(argv)
 
     base = RunConfig(
@@ -354,6 +467,9 @@ def main(argv=None) -> None:
         spec=args.spec,
         telemetry_dir=args.telemetry_dir,
         profile_dir=args.profile_dir,
+        retries=args.retries,
+        timeout_s=args.timeout_s or None,
+        on_error="continue" if args.continue_on_error else "fail",
     )
 
 

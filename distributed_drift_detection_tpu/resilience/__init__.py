@@ -1,0 +1,52 @@
+"""Resilience subsystem: supervised execution, deterministic fault
+injection, and registry-driven sweep healing.
+
+The reference's fault-tolerance is a human loop — notice the crash, diff
+the results CSV in a notebook, regenerate ``missing_exps.sh``, re-submit
+(SURVEY.md C14). This package closes that loop in code, layered on the
+telemetry registry (every run records running → completed/failed):
+
+* :mod:`.policy` — :class:`RetryPolicy`: attempts, deterministic seeded
+  exponential backoff, per-attempt wall-clock timeout, transient-vs-fatal
+  exception classification.
+* :mod:`.supervisor` — :func:`supervise` / :func:`supervised_run`: run a
+  callable / ``api.run`` under a policy; every attempt is bracketed in
+  the registry (``attempt`` field) and every retry emits a schema-v1
+  ``run_retried`` event — all strictly outside the reference-parity
+  Final Time span.
+* :mod:`.faults` — seeded deterministic fault injection at named sites
+  (crash a run, a sweep cell, a soak leg; tear a checkpoint or telemetry
+  write mid-file; simulate a timeout). No-ops unless explicitly armed.
+* :mod:`.heal` — the ``heal`` CLI: diff a sweep spec against the
+  registry's completed runs, emit the re-run plan as JSON + shell script,
+  ``--execute`` it under the supervisor until the sweep is whole.
+
+``import distributed_drift_detection_tpu.resilience`` stays jax-free
+(policy + faults are stdlib); :mod:`.supervisor` and :mod:`.heal` pull in
+the api lazily, so plan-mode healing runs wherever ``index.jsonl`` lands.
+"""
+
+from .faults import InjectedFault, InjectedTimeout
+from .policy import NO_RETRY, AttemptTimeout, RetryPolicy, TransientError
+
+__all__ = [
+    "RetryPolicy",
+    "NO_RETRY",
+    "TransientError",
+    "AttemptTimeout",
+    "InjectedFault",
+    "InjectedTimeout",
+    "supervise",
+    "supervised_run",
+]
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): supervisor imports the telemetry core and, inside
+    # supervised_run, api/jax — keeping the package import stdlib-light
+    # and cycle-free (api itself imports `.faults` at module level).
+    if name in ("supervise", "supervised_run"):
+        from . import supervisor
+
+        return getattr(supervisor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

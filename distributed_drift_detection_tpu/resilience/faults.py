@@ -1,0 +1,233 @@
+"""Deterministic, seeded fault injection for the resilience subsystem.
+
+The reference's crash story is untestable by construction: a "cluster
+crash" (README.md:13) happens to you, and the recovery dance
+(``missing_exps.sh``) is rehearsed only when it does. Here crashes are a
+*first-class test input*: known **sites** in the production code call
+:func:`fire`, which is a no-op unless that site was explicitly **armed**
+— so the supervised-retry, checkpoint-resume and sweep-heal paths are
+exercised by tests and a CI smoke job against real injected failures, not
+mocks.
+
+Sites (each named where the production code calls :func:`fire`):
+
+=====================  ====================================================
+``api.run``            start of a run, inside the registry bracket — a
+                       whole-run crash that leaves a ``failed`` record
+``grid.cell``          before each sweep trial (``harness.grid.run_grid``);
+                       re-fired on supervised retries of the cell
+``chunked.feed``       per chunk fed to ``engine.chunked.ChunkedDetector``
+                       ("raise at batch K", chunk granularity)
+``soak.leg``           before each chained-soak leg executes
+                       (``engine.soak.run_soak_chained``)
+``checkpoint.save``    between the checkpoint temp-file write and its
+                       atomic rename (``utils.checkpoint.save_checkpoint``)
+                       — ``kind='torn_write'`` truncates the temp file
+                       mid-byte first, simulating a kill mid-write
+``telemetry.emit``     inside ``telemetry.events.EventLog.emit`` —
+                       ``kind='torn_write'`` appends a partial JSON prefix
+                       (no newline) before raising: the torn-tail shape
+                       ``read_events(allow_partial_tail=True)`` tolerates
+=====================  ====================================================
+
+Arming is explicit (:func:`arm` in-process, or the ``DDD_FAULTS`` env var
+via :func:`arm_from_env` for CLI-driven sweeps) and deterministic: either
+positional — fire on the ``at``-th invocation of the site, for ``times``
+consecutive invocations — or seeded-Bernoulli (``rate`` + ``seed``: the
+decision hashes ``(seed, site, hit)``, so a given arming fires at the same
+hits in every run). No global RNG, no wall-clock.
+
+Pure stdlib, no jax; importing this module never arms anything.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from .policy import TransientError, _unit_interval
+
+
+class InjectedFault(TransientError):
+    """A deliberately injected failure. Subclasses ``TransientError`` so
+    the default :class:`..policy.RetryPolicy` classification retries it —
+    an injected crash stands in for the transient cluster failure the
+    subsystem exists to survive."""
+
+
+class InjectedTimeout(InjectedFault):
+    """The simulated-timeout fault (``kind='timeout'``): stands in for an
+    attempt that would have exceeded its wall-clock budget, without
+    actually sleeping."""
+
+
+ENV_VAR = "DDD_FAULTS"
+
+KINDS = ("raise", "timeout", "torn_write")
+
+# Every site a production call point declares; arming anything else is a
+# typo and fails loudly (the silent-no-op failure mode of a misspelled
+# site name would defeat the whole point of a fault test).
+SITES = frozenset(
+    {
+        "api.run",
+        "grid.cell",
+        "chunked.feed",
+        "soak.leg",
+        "checkpoint.save",
+        "telemetry.emit",
+    }
+)
+
+
+@dataclass
+class FaultSpec:
+    """One armed site. ``at``/``times`` are positional arming (fire on
+    hits ``[at, at + times)``; ``times=0`` = from ``at`` onward forever);
+    ``rate``/``seed`` (with ``at=0``) are seeded-Bernoulli arming."""
+
+    site: str
+    at: int = 1
+    times: int = 1
+    kind: str = "raise"
+    rate: float = 0.0
+    seed: int = 0
+    hits: int = 0  # invocations of the site seen since arming
+    fired: int = 0  # faults actually raised
+
+    def should_fire(self) -> bool:
+        if self.at:
+            if self.hits < self.at:
+                return False
+            return self.times == 0 or self.fired < self.times
+        if self.rate > 0.0:
+            if self.times and self.fired >= self.times:
+                return False
+            return _unit_interval(self.seed, self.site, self.hits) < self.rate
+        return False
+
+
+_ARMED: dict[str, FaultSpec] = {}
+
+
+def arm(
+    site: str,
+    *,
+    at: "int | None" = None,
+    times: int = 1,
+    kind: str = "raise",
+    rate: float = 0.0,
+    seed: int = 0,
+) -> FaultSpec:
+    """Arm ``site``; returns the live spec (its counters update as the
+    site is hit). Re-arming a site replaces its spec and resets counters.
+
+    Positional (``at=``, default 1 when no ``rate``) and seeded-Bernoulli
+    (``rate=`` + ``seed=``) arming are mutually exclusive: passing a
+    ``rate`` selects Bernoulli mode outright, and combining it with a
+    nonzero ``at`` is rejected rather than silently ignoring the rate."""
+    if site not in SITES:
+        raise ValueError(
+            f"unknown fault site {site!r}; expected one of {sorted(SITES)}"
+        )
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; expected one of {KINDS}")
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"rate must be in [0, 1], got {rate}")
+    if rate > 0.0 and at not in (None, 0):
+        raise ValueError(
+            "positional at= and Bernoulli rate= are mutually exclusive"
+        )
+    if at is None:
+        at = 0 if rate > 0.0 else 1
+    if at < 0 or times < 0:
+        raise ValueError("at/times must be >= 0")
+    if at == 0 and rate == 0.0:
+        raise ValueError("arm needs a positional `at` or a Bernoulli `rate`")
+    spec = FaultSpec(site=site, at=at, times=times, kind=kind, rate=rate, seed=seed)
+    _ARMED[site] = spec
+    return spec
+
+
+def disarm(site: str) -> None:
+    _ARMED.pop(site, None)
+
+
+def disarm_all() -> None:
+    _ARMED.clear()
+
+
+def armed(site: str) -> FaultSpec | None:
+    return _ARMED.get(site)
+
+
+def arm_from_env(spec: str | None = None) -> list[str]:
+    """Arm sites from the ``DDD_FAULTS`` env var (or an explicit string):
+    ``site:key=val,key=val`` entries separated by ``;`` — e.g.
+    ``DDD_FAULTS="grid.cell:at=4"`` crashes the 4th sweep trial, and
+    ``DDD_FAULTS="telemetry.emit:at=5,kind=torn_write"`` tears the 5th
+    emitted event. Returns the armed site names ([] when unset/empty).
+    Called by ``harness.grid.run_grid`` so CLI-driven sweeps can be
+    crashed without writing Python; everything else requires in-process
+    :func:`arm` calls.
+    """
+    if spec is None:
+        spec = os.environ.get(ENV_VAR, "")
+    sites = []
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        site, _, args = entry.partition(":")
+        kw: dict = {}
+        for pair in filter(None, (p.strip() for p in args.split(","))):
+            key, _, val = pair.partition("=")
+            if key in ("at", "times", "seed"):
+                kw[key] = int(val)
+            elif key == "rate":
+                kw[key] = float(val)
+            elif key == "kind":
+                kw[key] = val
+            else:
+                raise ValueError(
+                    f"{ENV_VAR}: unknown key {key!r} in entry {entry!r}"
+                )
+        arm(site.strip(), **kw)
+        sites.append(site.strip())
+    return sites
+
+
+def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = None, **context) -> None:
+    """Production-code hook: a no-op unless ``site`` is armed and its spec
+    elects this hit. When it fires:
+
+    * ``kind='raise'`` — raise :class:`InjectedFault`.
+    * ``kind='timeout'`` — raise :class:`InjectedTimeout`.
+    * ``kind='torn_write'`` — first *tear the write the site is about to
+      finish*: with ``fh``+``payload`` (the telemetry sink) append the
+      first half of the payload with no newline; with ``file`` (the
+      checkpoint temp file) truncate it to half its bytes; then raise.
+
+    ``context`` rides into the exception message for post-mortems.
+    """
+    if not _ARMED:
+        return
+    spec = _ARMED.get(site)
+    if spec is None:
+        return
+    spec.hits += 1
+    if not spec.should_fire():
+        return
+    spec.fired += 1
+    detail = f"injected fault at {site!r} (hit {spec.hits})"
+    if context:
+        detail += " " + " ".join(f"{k}={v!r}" for k, v in sorted(context.items()))
+    if spec.kind == "timeout":
+        raise InjectedTimeout(detail)
+    if spec.kind == "torn_write":
+        if fh is not None and payload is not None:
+            fh.write(payload[: max(len(payload) // 2, 1)])
+            fh.flush()
+        elif file is not None and os.path.exists(file):
+            size = os.path.getsize(file)
+            with open(file, "r+b") as tfh:
+                tfh.truncate(size // 2)
+        raise InjectedFault(detail + " (write torn)")
+    raise InjectedFault(detail)

@@ -1,0 +1,182 @@
+"""Supervised execution: retry a run under a :class:`RetryPolicy`.
+
+The reference re-runs crashed work by hand (regenerate ``missing_exps.sh``,
+re-submit). :func:`supervise` closes that loop in-process: it runs a
+callable under a policy, classifies each failure transient-vs-fatal,
+backs off deterministically, and makes every attempt *observable* —
+
+* each attempt executes inside ``telemetry.registry.attempt_scope(n)``, so
+  the ``running``/``completed``/``failed`` records the attempt writes into
+  ``index.jsonl`` carry an ``attempt`` field (a healed run reads as
+  ``failed(attempt=1) → completed(attempt=2)``, not as magic);
+* each retry emits a schema-v1 ``run_retried`` event into a dedicated
+  supervisor log in the telemetry directory (opened lazily — a run that
+  never retries leaves no extra artifact).
+
+Nothing here touches the reference-parity Final Time span: the supervisor
+wraps ``api.run`` from the *outside*, and all its telemetry lands between
+attempts (the purity test pins the span's instrumentation unchanged).
+
+The per-attempt wall-clock timeout runs the attempt on a worker thread and
+abandons it on expiry (Python cannot kill a thread): the abandoned attempt
+may keep consuming resources until its current device program returns, but
+the supervisor — and its caller's schedule — moves on. :class:`AttemptTimeout`
+is transient by construction. One consequence to size timeouts around: an
+abandoned attempt that later *finishes* still writes its side effects — a
+results-CSV row, a ``completed`` registry record — concurrently with the
+retry, so a timed-out-then-completed trial can leave duplicate artifacts
+for that one trial (trial keys and config digests are per-trial unique,
+so the resume/heal ledgers over-count that trial rather than skipping
+another; the surplus row is visible in both ledgers). Prefer budgets
+comfortably above the expected attempt time — the timeout is a hung-run
+escape hatch, not a scheduler.
+
+Imports jax only transitively and lazily (via ``api.run`` inside
+:func:`supervised_run`); :func:`supervise` itself is stdlib + the jax-free
+telemetry core.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+
+from ..telemetry import registry as run_registry
+from .policy import AttemptTimeout, RetryPolicy
+
+_SENTINEL = object()
+
+
+def _call_with_timeout(fn, timeout_s: float | None):
+    """Run ``fn()`` with a wall-clock budget; raise :class:`AttemptTimeout`
+    on expiry (the worker thread is abandoned — see module docstring)."""
+    if not timeout_s:
+        return fn()
+    box: dict = {}
+    # The attempt runs under the supervising thread's context (a fresh
+    # thread starts with an empty one): without this, the registry's
+    # attempt_scope contextvar would silently vanish from every record a
+    # timed attempt writes.
+    ctx = contextvars.copy_context()
+
+    def target():
+        try:
+            box["value"] = ctx.run(fn)
+        except BaseException as e:  # re-raised on the supervising thread
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True, name="supervised-attempt")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise AttemptTimeout(
+            f"attempt exceeded its {timeout_s} s wall-clock budget "
+            "(worker thread abandoned)"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def supervise(
+    fn,
+    policy: RetryPolicy = RetryPolicy(),
+    *,
+    telemetry_dir: str = "",
+    name: str = "",
+    sleep=time.sleep,
+    on_retry=None,
+):
+    """Run ``fn()`` under ``policy``; returns its result.
+
+    Retries transient failures up to ``policy.max_attempts`` total
+    attempts with deterministic seeded backoff (``policy.backoff_s``);
+    fatal failures and the final exhausted attempt re-raise the
+    *original* exception (annotated with the attempt count), so callers
+    keep their exception types — supervision changes how often something
+    runs, never what its failure looks like.
+
+    ``telemetry_dir`` enables the observability described in the module
+    docstring; ``name`` labels the supervisor's retry log. ``sleep`` is
+    injectable for tests (and anything that wants to veto the wait);
+    ``on_retry(attempt, exc, backoff_s)`` is an optional observer fired
+    before each backoff.
+    """
+    policy.validate()
+    log = None
+    try:
+        for attempt in range(1, policy.max_attempts + 1):
+            with run_registry.attempt_scope(attempt):
+                try:
+                    return _call_with_timeout(fn, policy.timeout_s)
+                except Exception as exc:
+                    kind = policy.classify(exc)
+                    final = attempt >= policy.max_attempts
+                    if kind == "fatal" or final:
+                        if hasattr(exc, "add_note"):
+                            exc.add_note(
+                                f"supervisor: attempt {attempt}/"
+                                f"{policy.max_attempts} "
+                                + (
+                                    "failed fatally (not retried)"
+                                    if kind == "fatal"
+                                    else "exhausted the retry budget"
+                                )
+                            )
+                        raise
+                    backoff = policy.backoff_s(attempt)
+                    if telemetry_dir:
+                        if log is None:
+                            from ..telemetry.events import EventLog
+
+                            log = EventLog.open_run(
+                                telemetry_dir,
+                                name=(name or "supervised") + "-retries",
+                            )
+                        log.emit(
+                            "run_retried",
+                            attempt=attempt,
+                            max_attempts=policy.max_attempts,
+                            reason=f"{type(exc).__name__}: {exc}",
+                            backoff_s=backoff,
+                            classification=kind,
+                        )
+                    if on_retry is not None:
+                        on_retry(attempt, exc, backoff)
+                    sleep(backoff)
+    finally:
+        if log is not None:
+            log.close()
+    raise AssertionError("unreachable: the loop returns or raises")
+
+
+def supervised_run(
+    cfg,
+    policy: RetryPolicy = RetryPolicy(),
+    *,
+    stream=None,
+    sleep=time.sleep,
+    on_retry=None,
+):
+    """``api.run(cfg)`` under a retry policy — the resilience wrapper for
+    one configured run; returns the :class:`..api.RunResult`.
+
+    With ``cfg.telemetry_dir`` set, every attempt registers itself in the
+    directory's ``index.jsonl`` with its ``attempt`` number (via
+    ``api.run``'s own registry bracket + :func:`attempt_scope
+    <..telemetry.registry.attempt_scope>`), and each retry emits a
+    ``run_retried`` event. A fresh stream is NOT reloaded per attempt when
+    the caller passed one in — pass ``stream=None`` (the default) if the
+    failure mode under retry includes a corrupted in-memory stream.
+    """
+    from ..api import run  # lazy: keeps `import resilience` jax-free
+
+    return supervise(
+        lambda: run(cfg, stream),
+        policy,
+        telemetry_dir=cfg.telemetry_dir or "",
+        name=cfg.resolved_app_name(),
+        sleep=sleep,
+        on_retry=on_retry,
+    )

@@ -24,6 +24,8 @@ import time
 
 import numpy as np
 
+from ..resilience import faults
+
 SCHEMA_VERSION = 1
 
 # type -> required payload fields (beyond the v/type/ts/seq envelope).
@@ -70,6 +72,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # of a device snapshot means the backend reports none (XLA CPU) —
     # never a fabricated zero.
     "memory_snapshot": ("source", "stats"),
+    # supervised retry (resilience.supervisor): attempt ``attempt`` of
+    # ``max_attempts`` failed with ``reason`` (the classified exception,
+    # as "Type: message") and will be re-run after ``backoff_s`` seconds.
+    # Emitted by the supervisor between attempts — strictly outside any
+    # run's Final Time span — into its own per-supervision log; the
+    # failed attempt's own run log + registry record carry the evidence.
+    "run_retried": ("attempt", "max_attempts", "reason", "backoff_s"),
     # one per run log, last event: totals over the reference's Final Time
     "run_completed": ("rows", "seconds", "detections"),
 }
@@ -185,7 +194,13 @@ class EventLog:
             **fields,
         }
         validate_event(event)
-        self._fh.write(json.dumps(event) + "\n")
+        payload = json.dumps(event)
+        # Fault-injection site (resilience.faults, no-op unless armed):
+        # kind='torn_write' appends a partial prefix of this payload with
+        # no newline and raises — the exact torn-tail artifact the
+        # allow_partial_tail read path and crash tests exercise.
+        faults.fire("telemetry.emit", fh=self._fh, payload=payload, seq=self._seq)
+        self._fh.write(payload + "\n")
         self._fh.flush()
         self._seq += 1
         return event

@@ -27,6 +27,8 @@ land.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import glob
 import hashlib
 import json
@@ -53,19 +55,53 @@ def index_path(telemetry_dir: str) -> str:
     return os.path.join(telemetry_dir, INDEX_NAME)
 
 
+# Supervision context (resilience.supervisor): while an attempt scope is
+# active, every record the producer writes carries an ``attempt`` field —
+# so a supervised run's index timeline reads failed(attempt=1) →
+# completed(attempt=2), not as two unexplained runs. A contextvar, not a
+# parameter, because the producer (api.run) is policy-agnostic: it must
+# not need to know whether something above it is retrying.
+_ATTEMPT: contextvars.ContextVar["int | None"] = contextvars.ContextVar(
+    "registry_attempt", default=None
+)
+
+
+@contextlib.contextmanager
+def attempt_scope(attempt: "int | None"):
+    """Bracket one supervised attempt: records written inside carry
+    ``attempt`` (1-based) unless they set their own."""
+    token = _ATTEMPT.set(None if attempt is None else int(attempt))
+    try:
+        yield
+    finally:
+        _ATTEMPT.reset(token)
+
+
+def current_attempt() -> "int | None":
+    return _ATTEMPT.get()
+
+
 def record(telemetry_dir: str, run_id: str, status: str, **extras) -> dict:
     """Append one status record; returns it. Creates the directory and
     index on first use. ``extras`` ride along verbatim (``config_digest``,
-    ``log``, host identity, sweep totals, ...)."""
+    ``log``, host identity, sweep totals, ...). Inside an
+    :func:`attempt_scope` the record additionally carries ``attempt``."""
     if status not in STATUSES:
         raise ValueError(
             f"unknown registry status {status!r}; expected one of {STATUSES}"
         )
+    attempt = _ATTEMPT.get()
+    if attempt is not None:
+        extras.setdefault("attempt", attempt)
     rec = {"ts": time.time(), "run_id": str(run_id), "status": status, **extras}
     os.makedirs(telemetry_dir, exist_ok=True)
     with open(index_path(telemetry_dir), "a") as fh:
         fh.write(json.dumps(rec) + "\n")
         fh.flush()
+        # fsync like the results CSV: the registry is what `heal` diffs a
+        # sweep spec against, so a `completed` that evaporates in a power
+        # loss would make heal re-run (duplicate) a recorded trial.
+        os.fsync(fh.fileno())
     return rec
 
 

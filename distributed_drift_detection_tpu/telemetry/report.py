@@ -43,6 +43,7 @@ def summarize(events: list[dict]) -> dict:
         "forced_retrains": 0,
         "chunks": [],
         "legs": [],
+        "retried": [],
         "heartbeat": None,
         "completed": None,
         "cost": None,
@@ -69,6 +70,8 @@ def summarize(events: list[dict]) -> dict:
             s["chunks"].append(e)
         elif t == "leg_completed":
             s["legs"].append(e)
+        elif t == "run_retried":
+            s["retried"].append(e)
         elif t == "heartbeat":
             s["heartbeat"] = e  # newest wins: the run's latest known pulse
         elif t == "cost_analysis":
@@ -265,6 +268,16 @@ def render_report(events: list[dict]) -> str:
         out.append(
             f"retrains   {s['retrains']}  ({s['forced_retrains']} forced "
             "by the saturation guard)"
+        )
+    if s["retried"]:
+        # Supervisor retry trail (resilience.supervisor): how many
+        # attempts were re-run and why the last one failed — the healed
+        # run's registry records carry the matching `attempt` fields.
+        last = s["retried"][-1]
+        out.append(
+            f"retries    {len(s['retried'])} attempt(s) re-run "
+            f"(last: attempt {last['attempt']}/{last['max_attempts']} — "
+            f"{last['reason']}; backoff {last['backoff_s']:.2f} s)"
         )
     if s["chunks"]:
         last = s["chunks"][-1]

@@ -9,15 +9,35 @@ offset — is one small pytree (a few KB per partition), saved as a flat
 template pytree (the natural situation on resume: rebuild the detector with
 the same config, then restore). Typed PRNG-key arrays round-trip via their
 uint32 key data.
+
+Crash posture (resilience subsystem): :func:`save_checkpoint` is
+**atomic** — it writes to a same-directory temp file, fsyncs, and
+``os.replace``s into place, so a crash mid-write (including the injected
+``checkpoint.save`` fault) can tear only the temp file, never a
+previously good checkpoint. :func:`load_checkpoint` turns the raw numpy
+zip errors a torn file produces into a clear
+:class:`CheckpointCorruptError` naming the path, so a resume that finds
+garbage says "torn/corrupt checkpoint", not ``BadZipFile``.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import zipfile
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..resilience import faults
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint file is torn or corrupt (crash mid-write on a
+    pre-atomic writer, bit rot, truncation). Subclasses ``RuntimeError``
+    — a retry policy classifies it transient, but the standard recovery
+    is to delete the file and restart the chain from scratch."""
 
 
 def _is_key(leaf) -> bool:
@@ -31,20 +51,53 @@ def _to_host(leaf) -> np.ndarray:
 
 
 def save_checkpoint(path: str, pytree, meta: dict | None = None) -> None:
+    """Atomically persist ``pytree`` (+ JSON-able ``meta``) to ``path``.
+
+    Write → flush → fsync → ``os.replace``: a reader never observes a
+    partial file at ``path``, and a crash between the temp write and the
+    rename leaves the previous checkpoint intact (the orphaned ``.tmp``
+    is overwritten by the next save). The temp file lives in the target's
+    directory so the rename stays same-filesystem (POSIX atomicity).
+    """
     leaves = jax.tree.leaves(pytree)
     arrays = {f"leaf_{i}": _to_host(leaf) for i, leaf in enumerate(leaves)}
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     )
-    with open(path, "wb") as fh:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
         np.savez(fh, **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
+    # Fault-injection site (resilience.faults; no-op unless armed): a
+    # kill between write and rename — kind='torn_write' truncates the
+    # temp file mid-byte first, the shape a real mid-write crash leaves.
+    faults.fire("checkpoint.save", file=tmp, path=path)
+    os.replace(tmp, path)
 
 
 def load_checkpoint(path: str, template) -> tuple[object, dict]:
-    """Restore a pytree with the same structure/shapes/dtypes as ``template``."""
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["__meta__"]).decode())
-        leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    """Restore a pytree with the same structure/shapes/dtypes as ``template``.
+
+    A file that cannot be parsed as a checkpoint archive raises
+    :class:`CheckpointCorruptError`; structural disagreements with the
+    template (leaf count, shapes) stay ``ValueError`` — that is a *wrong*
+    checkpoint, not a broken one.
+    """
+    try:
+        with np.load(path) as data:
+            meta = json.loads(bytes(data["__meta__"]).decode())
+            leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    # Only parse-shaped failures mean corruption; genuine I/O errors
+    # (permissions, a flaky mount) propagate as themselves — converting
+    # them would tell an operator to delete a perfectly good checkpoint.
+    except (zipfile.BadZipFile, EOFError, KeyError,
+            json.JSONDecodeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"torn/corrupt checkpoint {path!r}: cannot parse it as a saved "
+            f"state archive ({type(e).__name__}: {e}) — it was likely cut "
+            "off mid-write by a crash; delete it to restart from scratch"
+        ) from e
     t_leaves, treedef = jax.tree.flatten(template)
     if len(t_leaves) != len(leaves):
         raise ValueError(

@@ -1,0 +1,742 @@
+"""Resilience subsystem: retry policy, supervised execution, deterministic
+fault injection, registry-driven sweep healing, and the crash-safety
+satellites (atomic checkpoints, torn-tolerant CSV/JSONL tails).
+
+The acceptance contract (ISSUE 4): on a 3×2 sweep with one injected crash,
+``heal`` re-runs exactly the missing cells — no duplicates of completed
+ones — and the registry ends with every cell ``completed``; supervisor
+retry with backoff is deterministic under a fixed seed; and a checkpointed
+soak chain killed mid-leg resumes to bit-identical flags.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_drift_detection_tpu.api import run
+from distributed_drift_detection_tpu.config import (
+    RunConfig,
+    replace,
+    telemetry_config_payload,
+)
+from distributed_drift_detection_tpu.engine.soak import run_soak_chained
+from distributed_drift_detection_tpu.harness.grid import run_grid
+from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+from distributed_drift_detection_tpu.resilience import NO_RETRY, RetryPolicy, faults, heal
+from distributed_drift_detection_tpu.resilience.policy import (
+    AttemptTimeout,
+    TransientError,
+)
+from distributed_drift_detection_tpu.resilience.supervisor import (
+    supervise,
+    supervised_run,
+)
+from distributed_drift_detection_tpu.results import append_result, read_results
+from distributed_drift_detection_tpu.telemetry import registry
+from distributed_drift_detection_tpu.telemetry.events import (
+    EventLog,
+    SchemaError,
+    read_events,
+)
+from distributed_drift_detection_tpu.telemetry.report import render_report
+from distributed_drift_detection_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_everything():
+    """Fault arming is process-global state; no test may leak it."""
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _tiny_cfg(**kw):
+    return RunConfig(
+        dataset="synth:rialto,seed=0", mult_data=1, partitions=2,
+        per_batch=50, model="centroid", results_csv="", **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_backoff_deterministic_seeded():
+    """The acceptance pin: same seed → identical backoff schedule, always;
+    different seed → a different (de-synchronized) one."""
+    a = RetryPolicy(seed=7)
+    b = RetryPolicy(seed=7)
+    seq = [a.backoff_s(n) for n in (1, 2, 3, 4)]
+    assert seq == [b.backoff_s(n) for n in (1, 2, 3, 4)]
+    assert seq != [RetryPolicy(seed=8).backoff_s(n) for n in (1, 2, 3, 4)]
+    # jitter stays inside its band around the exponential curve
+    plain = RetryPolicy(seed=7, jitter=0.0)
+    for n, got in enumerate(seq, 1):
+        base = plain.backoff_s(n)
+        assert abs(got - base) <= 0.1 * base + 1e-12
+
+
+def test_policy_backoff_exponential_and_capped():
+    p = RetryPolicy(backoff_base_s=1.0, backoff_factor=3.0,
+                    backoff_max_s=10.0, jitter=0.0)
+    assert [p.backoff_s(n) for n in (1, 2, 3, 4)] == [1.0, 3.0, 9.0, 10.0]
+    with pytest.raises(ValueError, match="1-based"):
+        p.backoff_s(0)
+
+
+def test_policy_classification_defaults():
+    p = RetryPolicy()
+    assert p.classify(ValueError("bad config")) == "fatal"
+    assert p.classify(TypeError("x")) == "fatal"
+    assert p.classify(AssertionError("x")) == "fatal"
+    # unknown exception types default to transient (the supervisor exists
+    # for crashes nobody predicted); explicit transients stay transient
+    assert p.classify(RuntimeError("device lost")) == "transient"
+    assert p.classify(OSError("disk full")) == "transient"
+    assert p.classify(AttemptTimeout("slow")) == "transient"
+    assert p.classify(faults.InjectedFault("boom")) == "transient"
+    # explicit transient listing outranks the fatal defaults
+    class ConfigRace(ValueError):
+        pass
+
+    q = RetryPolicy(transient_types=(ConfigRace,))
+    assert q.classify(ConfigRace("x")) == "transient"
+    assert q.classify(ValueError("x")) == "fatal"
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0).validate()
+    with pytest.raises(ValueError, match="timeout_s"):
+        RetryPolicy(timeout_s=-1.0).validate()
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=1.5).validate()
+
+
+# ---------------------------------------------------------------------------
+# supervise
+# ---------------------------------------------------------------------------
+
+
+def test_supervise_retries_transient_until_success():
+    calls, slept = [], []
+    policy = RetryPolicy(max_attempts=4, seed=11)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        return "done"
+
+    assert supervise(flaky, policy, sleep=slept.append) == "done"
+    assert len(calls) == 3
+    # the slept schedule IS the policy's deterministic one
+    assert slept == [policy.backoff_s(1), policy.backoff_s(2)]
+
+
+def test_supervise_fatal_is_not_retried():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("wrong shape")
+
+    with pytest.raises(ValueError, match="wrong shape"):
+        supervise(bad, RetryPolicy(max_attempts=5), sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_supervise_exhausted_reraises_the_original_exception():
+    calls = []
+
+    class Flaky(TransientError):
+        pass
+
+    def always():
+        calls.append(1)
+        raise Flaky("still down")
+
+    with pytest.raises(Flaky, match="still down") as ei:
+        supervise(always, RetryPolicy(max_attempts=3), sleep=lambda s: None)
+    assert len(calls) == 3
+    if hasattr(ei.value, "add_note"):  # exception notes need Python >= 3.11
+        assert any("exhausted" in n for n in ei.value.__notes__)
+
+
+def test_supervise_timeout_is_transient_and_attempt_scoped():
+    import time as _time
+
+    calls = []
+
+    def slow_then_fast():
+        calls.append(registry.current_attempt())
+        if len(calls) == 1:
+            _time.sleep(5.0)  # abandoned by the supervisor
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=2, timeout_s=0.2, backoff_base_s=0.0,
+                         jitter=0.0)
+    assert supervise(slow_then_fast, policy, sleep=lambda s: None) == "ok"
+    # each attempt saw its own registry attempt scope
+    assert calls == [1, 2]
+    assert registry.current_attempt() is None  # scope does not leak
+
+
+def test_supervise_timeout_exhausted_raises_attempt_timeout():
+    import time as _time
+
+    policy = RetryPolicy(max_attempts=1, timeout_s=0.05)
+    with pytest.raises(AttemptTimeout, match="wall-clock"):
+        supervise(lambda: _time.sleep(5.0), policy, sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# faults
+# ---------------------------------------------------------------------------
+
+
+def test_faults_are_noops_unless_armed():
+    faults.fire("grid.cell")  # nothing armed: must not raise
+    faults.arm("soak.leg", at=1)
+    faults.fire("grid.cell")  # a different site stays inert
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("soak.leg")
+
+
+def test_faults_positional_arming_and_times():
+    faults.arm("grid.cell", at=2, times=2)
+    outcomes = []
+    for _ in range(5):
+        try:
+            faults.fire("grid.cell")
+            outcomes.append("ok")
+        except faults.InjectedFault:
+            outcomes.append("fault")
+    assert outcomes == ["ok", "fault", "fault", "ok", "ok"]
+
+
+def test_faults_seeded_rate_is_deterministic():
+    def pattern(seed):
+        faults.arm("grid.cell", at=0, rate=0.5, seed=seed, times=0)
+        out = []
+        for _ in range(16):
+            try:
+                faults.fire("grid.cell")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        faults.disarm_all()
+        return out
+
+    p1, p2 = pattern(3), pattern(3)
+    assert p1 == p2 and 0 < sum(p1) < 16
+    assert pattern(4) != p1
+
+
+def test_faults_unknown_site_and_kind_fail_loudly():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.arm("api.rnu")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.arm("api.run", kind="explode")
+
+
+def test_faults_rate_arming_needs_no_explicit_at():
+    """`arm(site, rate=p)` is Bernoulli mode outright — the rate must not
+    be silently shadowed by the positional default."""
+    spec = faults.arm("grid.cell", rate=0.5, seed=3)
+    assert spec.at == 0 and spec.rate == 0.5
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        faults.arm("grid.cell", at=2, rate=0.5)
+
+
+def test_faults_arm_from_env_spec():
+    names = faults.arm_from_env("grid.cell:at=3;soak.leg:at=0,rate=0.25,seed=9")
+    assert names == ["grid.cell", "soak.leg"]
+    assert faults.armed("grid.cell").at == 3
+    assert faults.armed("soak.leg").rate == 0.25
+    assert faults.arm_from_env("") == []
+    with pytest.raises(ValueError, match="unknown key"):
+        faults.arm_from_env("grid.cell:bogus=1")
+
+
+def test_fault_timeout_kind_classifies_transient():
+    faults.arm("api.run", kind="timeout")
+    with pytest.raises(faults.InjectedTimeout) as ei:
+        faults.fire("api.run")
+    assert RetryPolicy().classify(ei.value) == "transient"
+
+
+# ---------------------------------------------------------------------------
+# supervised_run: registry attempt bracketing + run_retried events
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_run_brackets_attempts_in_registry(tmp_path):
+    tele = str(tmp_path / "tele")
+    cfg = _tiny_cfg(telemetry_dir=tele)
+    faults.arm("api.run", at=1)  # first attempt crashes inside the bracket
+    res = supervised_run(
+        cfg, RetryPolicy(max_attempts=2, backoff_base_s=0.0, jitter=0.0)
+    )
+    assert res.metrics.num_detections > 0
+
+    recs = registry.read_index(tele)
+    by = [(r["status"], r.get("attempt")) for r in recs]
+    assert ("running", 1) in by and ("failed", 1) in by
+    assert ("running", 2) in by and ("completed", 2) in by
+
+    # the retry trail: one run_retried event in the supervisor's own log,
+    # schema-valid, rendered by the report CLI
+    sup_logs = [p for p in os.listdir(tele) if "retries" in p]
+    assert len(sup_logs) == 1
+    events = read_events(os.path.join(tele, sup_logs[0]))
+    (ev,) = events
+    assert ev["type"] == "run_retried"
+    assert ev["attempt"] == 1 and ev["max_attempts"] == 2
+    assert "InjectedFault" in ev["reason"]
+    out = render_report(events)
+    assert "retries    1 attempt(s) re-run" in out
+    assert "InjectedFault" in out
+
+
+def test_supervised_run_without_retries_leaves_no_retry_log(tmp_path):
+    tele = str(tmp_path / "tele")
+    supervised_run(_tiny_cfg(telemetry_dir=tele), NO_RETRY)
+    assert not [p for p in os.listdir(tele) if "retries" in p]
+    (rec,) = (
+        r for r in registry.runs(tele).values() if r.get("kind") is None
+    )
+    assert rec["status"] == "completed" and rec["attempt"] == 1
+
+
+def test_records_outside_attempt_scope_carry_no_attempt(tmp_path):
+    run(_tiny_cfg(telemetry_dir=str(tmp_path)))
+    for rec in registry.read_index(str(tmp_path)):
+        assert "attempt" not in rec
+
+
+def test_run_retried_event_schema(tmp_path):
+    log = EventLog.open_run(str(tmp_path), name="sup")
+    log.emit(
+        "run_retried", attempt=1, max_attempts=3, reason="RuntimeError: x",
+        backoff_s=0.5,
+    )
+    log.close()
+    assert read_events(log.path)[0]["type"] == "run_retried"
+    log2 = EventLog.open_run(str(tmp_path), name="sup2")
+    with pytest.raises(SchemaError, match="missing required"):
+        log2.emit("run_retried", attempt=1)
+    log2.close()
+
+
+def test_torn_telemetry_tail_fault_matches_partial_tail_contract(tmp_path):
+    """The injected torn tail is exactly the artifact the
+    allow_partial_tail read path was built for."""
+    log = EventLog.open_run(str(tmp_path), name="torn")
+    log.emit("run_started", run_id=log.run_id, config={})
+    log.emit("phase_completed", phase="detect", seconds=1.0)
+    faults.arm("telemetry.emit", kind="torn_write")
+    with pytest.raises(faults.InjectedFault, match="torn"):
+        log.emit("phase_completed", phase="collect", seconds=0.1)
+    log.close()
+    with pytest.raises(SchemaError):
+        read_events(log.path)  # strict: the tear is visible
+    events = read_events(log.path, allow_partial_tail=True)
+    assert [e["type"] for e in events] == ["run_started", "phase_completed"]
+
+
+# ---------------------------------------------------------------------------
+# satellites: atomic checkpoint, torn-tolerant results CSV
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    return {"w": np.arange(6, dtype=np.float32), "n": np.int32(3)}
+
+
+def test_checkpoint_save_is_atomic_under_mid_write_kill(tmp_path):
+    path = str(tmp_path / "state.npz")
+    save_checkpoint(path, _tree(), meta={"leg": 1})
+    faults.arm("checkpoint.save", kind="torn_write")
+    t2 = _tree()
+    t2["w"] = t2["w"] + 1
+    with pytest.raises(faults.InjectedFault):
+        save_checkpoint(path, t2, meta={"leg": 2})
+    # the previous checkpoint is untouched...
+    restored, meta = load_checkpoint(path, _tree())
+    assert meta == {"leg": 1}
+    np.testing.assert_array_equal(restored["w"], _tree()["w"])
+    # ...and the torn temp file reads as corruption, with a clear error
+    assert os.path.exists(path + ".tmp")
+    with pytest.raises(CheckpointCorruptError, match="torn/corrupt"):
+        load_checkpoint(path + ".tmp", _tree())
+    # a later save overwrites the orphaned temp and lands atomically
+    faults.disarm_all()
+    save_checkpoint(path, t2, meta={"leg": 2})
+    assert load_checkpoint(path, _tree())[1] == {"leg": 2}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_load_checkpoint_truncated_file_clear_error(tmp_path):
+    path = str(tmp_path / "state.npz")
+    save_checkpoint(path, _tree())
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 3)
+    with pytest.raises(CheckpointCorruptError, match="torn/corrupt checkpoint"):
+        load_checkpoint(path, _tree())
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "absent.npz"), _tree())
+
+
+def _result_row(app="key-t0"):
+    row = ["-"] * len(RESULT_COLUMNS)
+    row[RESULT_COLUMNS.index("Spark App")] = app
+    return row
+
+
+def test_read_results_tolerates_exactly_one_torn_trailing_row(tmp_path):
+    csv_path = str(tmp_path / "r.csv")
+    append_result(csv_path, _result_row("a-t0"))
+    append_result(csv_path, _result_row("a-t1"))
+    with open(csv_path, "a", newline="") as fh:
+        fh.write("torn,row")  # fewer fields, no newline: a killed append
+    with pytest.raises(ValueError, match="torn trailing row"):
+        read_results(csv_path)
+    rows = read_results(csv_path, allow_partial_tail=True)
+    assert [r["Spark App"] for r in rows] == ["a-t0", "a-t1"]
+
+    # an interior short row is corruption in both modes
+    bad = str(tmp_path / "bad.csv")
+    append_result(bad, _result_row("a-t0"))
+    with open(bad, "a", newline="") as fh:
+        fh.write("short,row\r\n")
+    append_result(bad, _result_row("a-t1"))
+    for kw in ({}, {"allow_partial_tail": True}):
+        with pytest.raises(ValueError, match="corrupt interior row"):
+            read_results(bad, **kw)
+
+
+def test_append_result_repairs_a_torn_tail_before_writing(tmp_path):
+    """A crashed writer's partial trailing row must not merge with the
+    next append into an overlong line no reader tolerates: append_result
+    drops the torn bytes under its lock (the partial trial was never
+    recorded, so the idempotent resume re-runs it)."""
+    csv_path = str(tmp_path / "r.csv")
+    append_result(csv_path, _result_row("a-t0"))
+    with open(csv_path, "a", newline="") as fh:
+        fh.write("torn,partial")  # killed mid-append, no newline
+    append_result(csv_path, _result_row("a-t1"))
+    rows = read_results(csv_path)  # the STRICT read succeeds post-repair
+    assert [r["Spark App"] for r in rows] == ["a-t0", "a-t1"]
+
+    # a torn header truncates to empty and is rewritten
+    bare = str(tmp_path / "bare.csv")
+    with open(bare, "w", newline="") as fh:
+        fh.write("Spark App,Da")
+    append_result(bare, _result_row("b-t0"))
+    (row,) = read_results(bare)
+    assert row["Spark App"] == "b-t0"
+
+
+def test_sweep_defaults_shared_between_grid_cli_and_heal_spec():
+    """One constant ties the grid CLI's flag defaults to heal's spec
+    schema — the digest-drift guard the heal docstrings rely on."""
+    from distributed_drift_detection_tpu.harness.grid import SWEEP_DEFAULTS
+
+    assert heal._SPEC_DEFAULTS is SWEEP_DEFAULTS
+
+
+def test_read_results_wellformed_roundtrip(tmp_path):
+    csv_path = str(tmp_path / "r.csv")
+    append_result(csv_path, _result_row("a-t0"))
+    for kw in ({}, {"allow_partial_tail": True}):
+        (row,) = read_results(csv_path, **kw)
+        assert row["Spark App"] == "a-t0"
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume: checkpointed soak chain, bit-identical flags
+# ---------------------------------------------------------------------------
+
+
+def test_soak_chain_killed_mid_leg_resumes_bit_identical(tmp_path):
+    """Satellite contract: a mid-leg crash injected into a checkpointed
+    chain resumes to flags bit-identical to an uninterrupted run."""
+    kw = dict(
+        partitions=2, per_batch=50, total_rows=20_000, drift_every=500,
+        max_leg_rows=5_000,
+    )
+    model = build_model("centroid", ModelSpec(8, 8))
+
+    def collect(into):
+        def on_leg(s, flags):
+            into[s] = jax.tree.map(np.asarray, flags)
+        return on_leg
+
+    clean: dict = {}
+    summary_clean = run_soak_chained(model, **kw, on_leg=collect(clean))
+    assert summary_clean.legs >= 4
+
+    ckpt = str(tmp_path / "chain.npz")
+    crashed: dict = {}
+    faults.arm("soak.leg", at=3)  # legs 0,1 complete; the kill lands at leg 2
+    with pytest.raises(faults.InjectedFault):
+        run_soak_chained(
+            model, **kw, checkpoint_path=ckpt, on_leg=collect(crashed)
+        )
+    faults.disarm_all()
+    assert sorted(crashed) == [0, 1] and os.path.exists(ckpt)
+
+    resumed: dict = {}
+    summary = run_soak_chained(
+        model, **kw, checkpoint_path=ckpt, on_leg=collect(resumed)
+    )
+    assert sorted(resumed) == [2, 3]  # only the unfinished legs re-ran
+
+    merged = {**crashed, **resumed}
+    assert sorted(merged) == sorted(clean)
+    for s in clean:
+        for got, want in zip(
+            jax.tree.leaves(merged[s]), jax.tree.leaves(clean[s])
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert summary.detections == summary_clean.detections
+    np.testing.assert_array_equal(summary.delays, summary_clean.delays)
+    assert not os.path.exists(ckpt)  # removed on success
+
+
+def test_chunked_feed_fault_site():
+    from distributed_drift_detection_tpu.engine import ChunkedDetector
+    from distributed_drift_detection_tpu.io import (
+        chunk_stream_arrays,
+        planted_prototypes,
+    )
+    from distributed_drift_detection_tpu.models import make_majority
+
+    stream = planted_prototypes(0, concepts=2, rows_per_concept=240, features=6)
+    det = ChunkedDetector(
+        make_majority(ModelSpec(stream.num_features, stream.num_classes)),
+        partitions=2, seed=0,
+    )
+    chunks = list(chunk_stream_arrays(stream.X, stream.y, 2, 40, chunk_batches=2))
+    faults.arm("chunked.feed", at=2)
+    det.feed(chunks[0])
+    with pytest.raises(faults.InjectedFault):
+        det.feed(chunks[1])
+    faults.disarm_all()
+    det.feed(chunks[1])  # the site fires before state advances: resumable
+
+
+# ---------------------------------------------------------------------------
+# grid wiring + heal: the acceptance sweep
+# ---------------------------------------------------------------------------
+
+
+def _sweep_spec(tmp_path):
+    csv = str(tmp_path / "results.csv")
+    spec = {
+        "dataset": "synth:rialto,seed=0",
+        "mults": [1, 2, 4],
+        "partitions": [1, 2],
+        "trials": 1,
+        "per_batch": 50,
+        "results_csv": csv,
+        "spec": "off",
+    }
+    path = str(tmp_path / "spec.json")
+    with open(path, "w") as fh:
+        json.dump(spec, fh)
+    return path, spec, csv
+
+
+def _cell_records(tele):
+    return [
+        r for r in registry.runs(tele).values() if r.get("kind") is None
+    ]
+
+
+def test_sweep_crash_then_heal_reruns_exactly_the_missing_cells(tmp_path):
+    """The ISSUE 4 acceptance sweep: 3 mults × 2 partitions, one injected
+    crash mid-sweep; heal re-runs exactly the missing cells and the
+    registry ends fully completed."""
+    spec_path, spec_dict, csv = _sweep_spec(tmp_path)
+    tele = str(tmp_path / "tele")
+    base = RunConfig(dataset=spec_dict["dataset"], per_batch=50,
+                     results_csv=csv)
+
+    # Crash the 3rd run INSIDE api.run's registry bracket, so the failed
+    # cell is recorded as failed, not merely absent.
+    faults.arm("api.run", at=3)
+    with pytest.raises(faults.InjectedFault):
+        run_grid(base, mults=[1, 2, 4], partitions=[1, 2], trials=1,
+                 spec="off", telemetry_dir=tele, progress=lambda *_: None)
+    faults.disarm_all()
+
+    cells = _cell_records(tele)
+    assert sorted(r["status"] for r in cells) == [
+        "completed", "completed", "failed",
+    ]
+    sweep_rec = [r for r in registry.runs(tele).values()
+                 if r.get("kind") == "sweep"]
+    assert sweep_rec[0]["status"] == "failed"
+
+    spec = heal.load_spec(spec_path)
+    plan = heal.sweep_plan(spec, tele)
+    assert plan["cells_total"] == 6 and plan["completed"] == 2
+    missing_names = [c["app_name"] for c in plan["missing"]]
+    assert len(missing_names) == 4
+
+    # plan artifacts: JSON + the regenerated missing_exps.sh
+    heal.write_plan_json(plan, str(tmp_path / "plan.json"))
+    got = json.load(open(tmp_path / "plan.json"))
+    assert [c["app_name"] for c in got["missing"]] == missing_names
+    script = str(tmp_path / "missing.sh")
+    heal.write_plan_script(plan, spec_path, script, retries=5, timeout_s=600)
+    text = open(script).read()
+    assert text.count("--execute --cell") == 4
+    # the operator's retry budget survives into every generated line
+    assert text.count("--retries 5 --timeout-s 600.0") == 4
+    for name in missing_names:
+        assert name in text
+    assert os.access(script, os.X_OK)
+
+    executed = heal.execute(
+        spec, tele,
+        policy=RetryPolicy(max_attempts=2, backoff_base_s=0.0, jitter=0.0),
+        progress=lambda *_: None,
+    )
+    assert executed == 4  # exactly the missing cells, nothing twice
+
+    after = heal.sweep_plan(spec, tele)
+    assert after["completed"] == 6 and not after["missing"]
+    # every expected digest completed exactly once — no duplicates of the
+    # cells the crashed sweep already delivered
+    digests = [
+        registry.config_digest(telemetry_config_payload(cfg))
+        for cfg in heal.spec_configs(spec)
+    ]
+    done = heal.completed_digests(tele)
+    assert done == {d: 1 for d in digests}
+    # the heal pass bracketed itself in the registry
+    heal_recs = [r for r in registry.runs(tele).values()
+                 if r.get("kind") == "heal"]
+    assert heal_recs and heal_recs[0]["status"] == "completed"
+    # and the CSV ledger agrees with the registry: 6 rows, one per cell
+    assert len(read_results(csv)) == 6
+
+    # healing a whole sweep is a no-op
+    assert heal.execute(spec, tele, progress=lambda *_: None) == 0
+
+
+def test_heal_execute_scoped_to_one_cell(tmp_path):
+    spec_path, spec_dict, csv = _sweep_spec(tmp_path)
+    tele = str(tmp_path / "tele")
+    spec = heal.load_spec(spec_path)
+    plan = heal.sweep_plan(spec, tele)
+    target = plan["missing"][0]["app_name"]
+    n = heal.execute(
+        spec, tele, only={target}, policy=NO_RETRY, progress=lambda *_: None
+    )
+    assert n == 1
+    after = heal.sweep_plan(spec, tele)
+    assert target not in {c["app_name"] for c in after["missing"]}
+    assert after["completed"] == 1
+    # an already-completed cell is skipped (idempotent script re-runs)...
+    notes = []
+    assert heal.execute(spec, tele, only={target}, progress=notes.append) == 0
+    assert any("already completed" in n for n in notes)
+    # ...but a name the spec does not contain must not read as healed
+    with pytest.raises(ValueError, match="not in the sweep spec"):
+        heal.execute(spec, tele, only={"no-such-cell"},
+                     progress=lambda *_: None)
+    with pytest.raises(SystemExit, match="not in the sweep spec"):
+        heal.main([spec_path, "--telemetry-dir", tele, "--cell", "typo"])
+
+
+def test_heal_cli_plan_and_exit_codes(tmp_path, capsys):
+    spec_path, spec_dict, csv = _sweep_spec(tmp_path)
+    tele = str(tmp_path / "tele")
+    with pytest.raises(SystemExit) as ei:
+        heal.main([spec_path, "--telemetry-dir", tele,
+                   "--script", str(tmp_path / "m.sh")])
+    assert ei.value.code == 1  # trials missing → nonzero (wholeness check)
+    out = capsys.readouterr().out
+    assert "sweep: 6 trials, 0 completed, 6 missing" in out
+    assert os.path.exists(tmp_path / "m.sh")
+
+    # an unknown spec key fails loudly (a typo must not heal the defaults)
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({**spec_dict, "model": ["centroid"]}, fh)
+    with pytest.raises(ValueError, match="unknown sweep-spec key"):
+        heal.load_spec(bad)
+
+
+def test_grid_retries_heal_an_injected_transient_crash_in_place(tmp_path):
+    """A cell whose first attempt crashes is retried under the grid's own
+    policy and the sweep completes — with the attempt trail in the
+    registry and a run_retried event on disk."""
+    tele = str(tmp_path / "tele")
+    base = RunConfig(dataset="synth:rialto,seed=0", per_batch=50,
+                     results_csv=str(tmp_path / "r.csv"))
+    faults.arm("grid.cell", at=2)  # second supervised attempt stream: cell 2, attempt 1
+    n = run_grid(base, mults=[1], partitions=[1, 2], trials=1, spec="off",
+                 telemetry_dir=tele, retries=1, progress=lambda *_: None)
+    assert n == 2
+    cells = _cell_records(tele)
+    assert sorted(r["status"] for r in cells) == ["completed", "completed"]
+    assert any(r.get("attempt") == 2 for r in cells)
+    sup_logs = [p for p in os.listdir(tele) if "retries" in p]
+    assert len(sup_logs) == 1
+    (ev,) = read_events(os.path.join(tele, sup_logs[0]))
+    assert ev["type"] == "run_retried" and "InjectedFault" in ev["reason"]
+    sweep_rec = [r for r in registry.runs(tele).values()
+                 if r.get("kind") == "sweep"]
+    assert sweep_rec[0]["status"] == "completed"
+
+
+def test_grid_continue_past_failed_cell(tmp_path):
+    tele = str(tmp_path / "tele")
+    csv = str(tmp_path / "r.csv")
+    base = RunConfig(dataset="synth:rialto,seed=0", per_batch=50,
+                     results_csv=csv)
+    faults.arm("grid.cell", at=2)
+    with pytest.raises(RuntimeError, match="1 of 3 trials failed"):
+        run_grid(base, mults=[1, 2, 4], partitions=[1], trials=1, spec="off",
+                 telemetry_dir=tele, on_error="continue",
+                 progress=lambda *_: None)
+    # the cells after the failed one still ran
+    assert len(read_results(csv)) == 2
+    sweep_rec = [r for r in registry.runs(tele).values()
+                 if r.get("kind") == "sweep"]
+    assert sweep_rec[0]["status"] == "failed"
+    assert sweep_rec[0]["trials_failed"] == 1
+
+    # the idempotent resume finishes the sweep (the fault was consumed)
+    n = run_grid(base, mults=[1, 2, 4], partitions=[1], trials=1, spec="off",
+                 telemetry_dir=tele, on_error="continue",
+                 progress=lambda *_: None)
+    assert n == 1 and len(read_results(csv)) == 3
+
+
+def test_grid_rejects_bad_on_error():
+    base = RunConfig(dataset="synth:rialto,seed=0", per_batch=50,
+                     results_csv="")
+    with pytest.raises(ValueError, match="on_error"):
+        run_grid(base, mults=[1], partitions=[1], on_error="ignore")
+
+
+def test_main_usage_mentions_heal():
+    from distributed_drift_detection_tpu.__main__ import _USAGE
+
+    assert "heal SPEC" in _USAGE

@@ -43,6 +43,10 @@ EXAMPLE_EVENTS = {
     "memory_snapshot": dict(
         source="memory_analysis", stats={"temp_bytes": 14_401_584}
     ),
+    "run_retried": dict(
+        attempt=1, max_attempts=3, reason="RuntimeError: device lost",
+        backoff_s=0.55,
+    ),
     "run_completed": dict(rows=2_048_000, seconds=0.16, detections=600),
 }
 
