@@ -19,14 +19,20 @@ run's telemetry artifacts; mutually exclusive with ``--trace-dir``), and
 ``--telemetry-dir DIR`` persists the structured JSONL run log + metric
 exports (telemetry subsystem).
 
-Five further subcommands work offline (no accelerator, no data — just the
-artifacts; ``heal --execute`` is the one that runs experiments):
+A fourth flag, ``--data-policy {strict,quarantine,repair}``, selects the
+ingest contract policy for dirty CSVs (``io.sanitize``; strict is the
+default — fail loudly, never compute on garbage).
+
+Six further subcommands work offline (no accelerator — ``doctor`` reads
+the data, the rest just the artifacts; ``heal --execute`` is the one that
+runs experiments):
 
     python -m distributed_drift_detection_tpu report <run.jsonl | --dir DIR>
     python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
     python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
+    python -m distributed_drift_detection_tpu doctor CSV [CSV ...]
 
 ``report`` renders a persisted run log (``--dir`` picks a telemetry
 directory's newest run); ``perf`` diffs bench artifacts across rounds per
@@ -38,7 +44,9 @@ per-process logs into one timeline with straggler diagnostics
 (telemetry.correlate); ``heal`` diffs a sweep spec against the
 registry's completed runs and emits — or ``--execute``s under the
 retry supervisor — the re-run plan for whatever a crash left missing
-(resilience.heal; plan mode is jax-free, exit 0 = sweep whole).
+(resilience.heal; plan mode is jax-free, exit 0 = sweep whole);
+``doctor`` validates CSV inputs against the ingest contract jax-free and
+exits nonzero on violations (io.sanitize — the pre-flight for sweeps).
 """
 
 import sys
@@ -46,12 +54,14 @@ import sys
 _USAGE = (
     "usage: python -m distributed_drift_detection_tpu "
     "[--trace-dir DIR] [--profile-dir DIR] [--telemetry-dir DIR] "
+    "[--data-policy strict|quarantine|repair] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
-    "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR"
+    "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
+    "       python -m distributed_drift_detection_tpu doctor CSV [CSV ...]"
 )
 
 
@@ -101,6 +111,12 @@ def main(argv: list[str]) -> None:
 
         heal_main(argv[1:])
         return
+    if argv and argv[0] == "doctor":
+        # jax-free: the ingest pre-flight runs wherever the data lands.
+        from .io.sanitize import main as doctor_main
+
+        doctor_main(argv[1:])
+        return
 
     argv = list(argv)
     kw = {}
@@ -113,6 +129,16 @@ def main(argv: list[str]) -> None:
     telemetry_dir = _pop_flag(argv, "--telemetry-dir")
     if telemetry_dir is not None:
         kw["telemetry_dir"] = telemetry_dir
+    data_policy = _pop_flag(argv, "--data-policy")
+    if data_policy is not None:
+        from .config import DATA_POLICIES
+
+        if data_policy not in DATA_POLICIES:
+            raise SystemExit(
+                f"{_USAGE}\n(--data-policy must be one of "
+                f"{'|'.join(DATA_POLICIES)}, got {data_policy!r})"
+            )
+        kw["data_policy"] = data_policy
     if argv and len(argv) not in (6, 7):
         raise SystemExit(_USAGE)
     if argv:

@@ -155,9 +155,34 @@ def _cached_runner(
 def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     """Load, stripe and compile-build a run without executing it."""
     if stream is None:
+        from .config import resolve_quarantine_path
+
+        # Ingest contract (io.sanitize): strict fails loudly on dirty
+        # rows, quarantine masks them (sidecar next to the run's other
+        # artifacts), repair imputes. The loader validates the policy
+        # name before any work.
         stream = load_stream(
-            cfg.dataset, cfg.mult_data, seed=cfg.seed, standardize=cfg.standardize
+            cfg.dataset,
+            cfg.mult_data,
+            seed=cfg.seed,
+            standardize=cfg.standardize,
+            data_policy=cfg.data_policy,
+            # repair quarantines what it cannot fix, so it writes the
+            # sidecar too; strict never drops a row, so it never needs one
+            quarantine_path=(
+                resolve_quarantine_path(cfg)
+                if cfg.data_policy in ("quarantine", "repair")
+                else None
+            ),
         )
+    if cfg.validate:
+        # Host-side ingest audit (utils.validate): valid rows must be
+        # finite with labels in 0..C-1 — the promotion of the in-jit
+        # checkify guards to a run-level switch. Cheap relative to the
+        # run; outside the Final Time span (prepare phase).
+        from .utils.validate import validate_stream
+
+        validate_stream(stream)
     # Per-batch shuffle (C7 :187,190) is applied host-side at stripe time —
     # each batch is visited once, so this is semantically identical to an
     # in-loop shuffle but free on device (see io.stream.stripe_chunk).
@@ -186,7 +211,13 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
                 threshold=auto_ph_threshold(cfg, stream.dist_between_changes)
             ),
         )
-    indexed = stream.src is not None and cfg.window > 1
+    # Quarantine-masked streams ride the dense striper: the packed form
+    # synthesizes `valid` from pure geometry in-jit, and a row mask is
+    # data, not geometry (flags are bit-identical across stripers).
+    indexed = (
+        stream.src is not None and cfg.window > 1
+        and not stream.has_masked_rows
+    )
     striper = stripe_partitions_packed if indexed else stripe_partitions
     batches = striper(
         stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
@@ -312,6 +343,22 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
         # record + partial log land exactly as a real crash would leave
         # them — what the supervised-retry and heal tests exercise.
         faults.fire("api.run", run_id=None if log is None else log.run_id)
+        # Telemetered runs get a PER-RUN quarantine sidecar named after
+        # the run log (<run>.quarantine.jsonl): the sidecar is append-only
+        # by design, and a shared fixed path would interleave every
+        # trial's records with no way to attribute them to a run. An
+        # explicit quarantine_path still wins; without telemetry the
+        # resolve_quarantine_path default applies.
+        if (
+            log is not None
+            and cfg.data_policy in ("quarantine", "repair")
+            and not cfg.quarantine_path
+        ):
+            cfg = replace(
+                cfg,
+                quarantine_path=os.path.splitext(log.path)[0]
+                + ".quarantine.jsonl",
+            )
         with timer.phase("prepare"):
             prep = prepare(cfg, stream)
         stream, batches, runner, keys, mesh = (
@@ -329,6 +376,20 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
             from .telemetry.profile import device_memory_stats
 
             pre_mem = device_memory_stats()
+            # Ingest-quarantine evidence (io.sanitize, data_policy=
+            # 'quarantine'/'repair'): emitted here, between prepare and
+            # the span open — outside the reference-parity timed region,
+            # like the memory snapshot above. Only when rows were
+            # actually dropped: a clean stream leaves no trace.
+            q = prep.stream.quarantine
+            if q is not None and q.rows_quarantined:
+                log.emit(
+                    "rows_quarantined",
+                    rows=q.rows_quarantined,
+                    policy=q.policy,
+                    sidecar=q.sidecar,
+                    repaired=q.rows_repaired,
+                )
 
         # --- the reference's Final Time span starts here (:224) ---
         # cfg.profile_dir (opt-in) wraps the WHOLE span in a jax.profiler
@@ -501,6 +562,12 @@ def _finish_telemetry(
     reg.counter(
         "rows_processed_total", help="Stream rows through the detection loop"
     ).inc(stream.num_rows)
+    if stream.quarantine is not None and stream.quarantine.rows_quarantined:
+        from .io.sanitize import QUARANTINE_METRIC, QUARANTINE_METRIC_HELP
+
+        reg.counter(QUARANTINE_METRIC, help=QUARANTINE_METRIC_HELP).inc(
+            stream.quarantine.rows_quarantined
+        )
     reg.gauge(
         "compile_seconds", help="Runner build time (0 on runner-cache hit)"
     ).set(info["build_seconds"])

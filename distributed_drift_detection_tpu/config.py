@@ -247,6 +247,11 @@ DETECTOR_NAMES = (
     "ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd",
 )
 
+# Valid RunConfig.data_policy values (io/sanitize.py POLICIES — mirrored
+# here, like DETECTOR_NAMES, so jax-free consumers (grid/heal/doctor CLIs)
+# validate without importing the io package, which pulls in jax).
+DATA_POLICIES = ("strict", "quarantine", "repair")
+
 
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
@@ -256,6 +261,27 @@ class RunConfig:
     dataset: str = "outdoorStream.csv"
     mult_data: float = 1.0
     standardize: bool = True
+    # Ingest contract policy for CSV datasets (io/sanitize.py): 'strict'
+    # (default) raises a structured StreamContractError naming
+    # file/row/column on any violation — non-numeric cell, non-finite
+    # value, ragged row, bad label domain — instead of the reference's
+    # crash-or-poison behaviour; 'quarantine' drops violating rows into a
+    # quarantine.jsonl sidecar and carries them as masked positions
+    # (inside jit they read as padding — static shapes, and the detector
+    # statistics are exactly the clean stream's with those rows masked);
+    # 'repair' imputes finite column means for NaN cells and clamps
+    # non-integral labels, quarantining what it cannot fix. Clean streams
+    # are bit-identical under every policy. Synthetic datasets ('synth:')
+    # generate by construction and skip the scan.
+    data_policy: str = "strict"
+    # Quarantine sidecar path ('' = auto: telemetered runs write a
+    # per-run `<run-log>.quarantine.jsonl` next to the run log so
+    # repeated trials stay attributable; without telemetry it is
+    # ./quarantine.jsonl — resolve_quarantine_path). Appended to, one
+    # JSON line per quarantined row; written only when a row is actually
+    # quarantined (quarantine AND repair policies — repair drops what it
+    # cannot fix).
+    quarantine_path: str = ""
 
     # --- loop (reference C7, DDM_Process.py:162-213) ---
     per_batch: int = 100
@@ -404,6 +430,20 @@ def replace(cfg: RunConfig, **kw: Any) -> RunConfig:
     return dataclasses.replace(cfg, **kw)
 
 
+def resolve_quarantine_path(cfg: RunConfig) -> str:
+    """The quarantine sidecar path a config implies: an explicit
+    ``quarantine_path`` wins; otherwise it lands next to the run's other
+    artifacts (``<telemetry_dir>/quarantine.jsonl``) when telemetry is
+    on, or in the working directory when not. jax-free (CLI-safe)."""
+    if cfg.quarantine_path:
+        return cfg.quarantine_path
+    if cfg.telemetry_dir:
+        import os
+
+        return os.path.join(cfg.telemetry_dir, "quarantine.jsonl")
+    return "quarantine.jsonl"
+
+
 def telemetry_config_payload(cfg: RunConfig) -> dict:
     """The config dict ``api.run`` emits in ``run_started`` and digests
     into the registry (``telemetry.registry.config_digest``).
@@ -421,7 +461,7 @@ def telemetry_config_payload(cfg: RunConfig) -> dict:
     launched with integer mults and a heal planner normalizing to float
     would digest the *same cell* two ways and re-run completed work.
     """
-    return {
+    payload = {
         "dataset": str(cfg.dataset),
         "model": cfg.model,
         "detector": cfg.detector,
@@ -433,6 +473,15 @@ def telemetry_config_payload(cfg: RunConfig) -> dict:
         "window": int(cfg.window),
         "window_rotations": int(cfg.window_rotations),
     }
+    # A non-default data policy is experiment identity: on a dirty stream
+    # it changes which rows reach the detector, hence the flags. The
+    # default stays OUT of the payload — same rule as the grid's
+    # _config_key `-dp` segment — so registries recorded before the
+    # policy existed keep matching their cells (heal must not re-run a
+    # whole completed sweep over a digest-schema change).
+    if cfg.data_policy != "strict":
+        payload["data_policy"] = str(cfg.data_policy)
+    return payload
 
 
 # Version of the auto W×R resolution policy (auto_window / auto_rotations).

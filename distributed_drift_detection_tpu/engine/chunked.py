@@ -60,6 +60,7 @@ class ChunkedDetector:
         mesh=None,
         detector=None,
         rotations: int = 1,
+        validate: bool = False,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -150,6 +151,14 @@ class ChunkedDetector:
             )
         else:
             self._run_chunk = jax.jit(jax.vmap(run_chunk))
+        # ``validate=True``: audit the concatenated flag table at the end
+        # of :meth:`run` with the same structural checks the one-shot
+        # path runs under RunConfig(validate=True)
+        # (utils.validate.validate_flag_rows) — sentinel domain, index
+        # ranges, warning/change ordering — so index-plane corruption is
+        # caught on the chunked path too, not just api.run's.
+        self.validate = validate
+        self._per_batch: int | None = None
         self._seed = seed
         self.carry: LoopCarry | None = None
         self.batches_done = 0
@@ -196,6 +205,7 @@ class ChunkedDetector:
         faults.fire("chunked.feed", batches_done=self.batches_done)
         if self._feed_started is None:
             self._feed_started = time.monotonic()
+        self._per_batch = int(chunk.y.shape[2])
         self.rows_done += int(
             chunk.y.shape[0] * chunk.y.shape[1] * chunk.y.shape[2]
         )
@@ -291,6 +301,7 @@ class ChunkedDetector:
         ``metrics`` records the per-chunk device-memory gauges (no sync —
         usable with or without the event log).
         """
+        start_batches = self.batches_done
         out = []
         for i, chunk in enumerate(chunks):
             flags = self.feed(chunk)
@@ -303,7 +314,24 @@ class ChunkedDetector:
             if progress is not None:
                 progress(i, self.batches_done)
         host = [jax.tree.map(np.asarray, f) for f in out]
-        return FlagRows(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
+        flags = FlagRows(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
+        if self.validate and self._per_batch is not None:
+            from ..utils.validate import validate_flag_rows
+
+            # The expected flag width comes from the independently-counted
+            # fed batches (chunk shapes), so a dropped or duplicated
+            # chunk boundary is caught like the one-shot path's geometry
+            # check; rows_done (padded grid positions fed) upper-bounds
+            # every real global stream position. Bounds assume the drain
+            # starts at stream position 0 (feeders with a start_row
+            # offset resume a stream this audit cannot re-derive).
+            validate_flag_rows(
+                flags,
+                self.batches_done - start_batches + 1,
+                self._per_batch,
+                self.rows_done,
+            )
+        return flags
 
     # -- checkpoint / resume (SURVEY.md §5) ----------------------------------
 

@@ -27,6 +27,7 @@ from collections import Counter
 
 from ..config import (
     AUTO_POLICY_VERSION,
+    DATA_POLICIES,
     DETECTOR_NAMES,
     RunConfig,
     replace,
@@ -50,6 +51,7 @@ SWEEP_DEFAULTS = {
     "seed": 0,
     "results_csv": "ddm_cluster_runs.csv",
     "spec": "warn",
+    "data_policy": "strict",
 }
 
 
@@ -109,6 +111,15 @@ def _config_key(cfg: RunConfig) -> str:
     win = f"-w{cfg.window}r{cfg.window_rotations}"
     if cfg.window == 0 or cfg.window_rotations == 0:
         win += f"v{AUTO_POLICY_VERSION}"
+    if cfg.data_policy not in DATA_POLICIES:
+        raise ValueError(
+            f"unknown data_policy {cfg.data_policy!r}; expected one of "
+            f"{DATA_POLICIES}"
+        )
+    # Non-default data policies change which rows reach the detector on a
+    # dirty stream, so they are trial identity; the default stays
+    # unsegmented so pre-policy completed trials remain valid.
+    dp = "" if cfg.data_policy == "strict" else f"-dp{cfg.data_policy}"
     # The detector segment carries the active statistic's name + full
     # parameter tuple; non-DDM detectors embed only their own params — the
     # DDM tuple is inert for them and must not invalidate completed trials.
@@ -126,7 +137,7 @@ def _config_key(cfg: RunConfig) -> str:
         )
     return (
         f"m{cfg.mult_data}-p{cfg.partitions}-{cfg.model}-b{cfg.per_batch}"
-        f"{win}-{det}-s{cfg.seed}{thr}"
+        f"{win}-{det}-s{cfg.seed}{thr}{dp}"
     )
 
 
@@ -416,6 +427,15 @@ def main(argv=None) -> None:
         "the check",
     )
     ap.add_argument(
+        "--data-policy",
+        default=SWEEP_DEFAULTS["data_policy"],
+        choices=list(DATA_POLICIES),
+        help="ingest contract policy for dirty CSVs (io.sanitize): strict "
+        "= fail loudly on the first violating row; quarantine = mask "
+        "violating rows (quarantine.jsonl sidecar) and continue; repair "
+        "= impute NaN cells / clamp labels, quarantining the rest",
+    )
+    ap.add_argument(
         "--telemetry-dir",
         default="",
         help="per-trial JSONL run logs into this directory (telemetry "
@@ -455,6 +475,7 @@ def main(argv=None) -> None:
         dataset=args.dataset,
         per_batch=args.per_batch,
         results_csv=args.results_csv,
+        data_policy=args.data_policy,
     )
     run_grid(
         base,

@@ -1,48 +1,59 @@
-from .datasets import convert_data_labels_to_csv, rialto_fixture_csv
-from .feeder import (
-    chunk_stream_arrays,
-    csv_chunks,
-    generator_chunks,
-    prefetch_chunks,
-)
-from .stream import (
-    StreamData,
-    load_csv,
-    load_stream,
-    materialize_batches,
-    stripe_partitions,
-    stripe_partitions_indexed,
-    stripe_partitions_packed,
-    synthesize_stream,
-)
-from .synth import (
-    as_stream,
-    hyperplane_chunk,
-    hyperplane_stream,
-    planted_prototypes,
-    sea_chunk,
-    sea_stream,
-)
+"""Data-plane package: loaders, synthesis, striping, feeding, sanitizing.
 
-__all__ = [
-    "chunk_stream_arrays",
-    "convert_data_labels_to_csv",
-    "rialto_fixture_csv",
-    "csv_chunks",
-    "generator_chunks",
-    "prefetch_chunks",
-    "StreamData",
-    "load_csv",
-    "load_stream",
-    "materialize_batches",
-    "stripe_partitions",
-    "stripe_partitions_indexed",
-    "stripe_partitions_packed",
-    "synthesize_stream",
-    "as_stream",
-    "hyperplane_chunk",
-    "hyperplane_stream",
-    "planted_prototypes",
-    "sea_chunk",
-    "sea_stream",
-]
+Exports resolve **lazily** (PEP 562): ``io.sanitize`` is jax-free by
+contract (the ``doctor`` CLI and the quarantine-sidecar reader must run
+wherever the data lands), but ``io.stream``/``io.feeder`` import the
+engine types and hence jax — an eager ``__init__`` would drag jax into
+every ``from .io.sanitize import ...``. Attribute access is unchanged
+for callers; only the import cost moved.
+"""
+
+_EXPORTS = {
+    # datasets
+    "convert_data_labels_to_csv": ".datasets",
+    "rialto_fixture_csv": ".datasets",
+    # feeder
+    "chunk_stream_arrays": ".feeder",
+    "csv_chunks": ".feeder",
+    "generator_chunks": ".feeder",
+    "prefetch_chunks": ".feeder",
+    # sanitize (jax-free)
+    "QuarantineReport": ".sanitize",
+    "StreamContractError": ".sanitize",
+    "load_csv_sane": ".sanitize",
+    "read_quarantine": ".sanitize",
+    "scan_csv": ".sanitize",
+    # stream
+    "StreamData": ".stream",
+    "load_csv": ".stream",
+    "load_stream": ".stream",
+    "materialize_batches": ".stream",
+    "stripe_partitions": ".stream",
+    "stripe_partitions_indexed": ".stream",
+    "stripe_partitions_packed": ".stream",
+    "synthesize_stream": ".stream",
+    # synth
+    "as_stream": ".synth",
+    "hyperplane_chunk": ".synth",
+    "hyperplane_stream": ".synth",
+    "planted_prototypes": ".synth",
+    "sea_chunk": ".synth",
+    "sea_stream": ".synth",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(_EXPORTS[name], __name__)
+        value = getattr(module, name)
+        globals()[name] = value  # cache: next access skips __getattr__
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

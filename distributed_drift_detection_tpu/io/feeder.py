@@ -37,6 +37,14 @@ def _ingest_counters(metrics):
     )
 
 
+def _quarantine_counter(metrics):
+    """The quarantine counter, name/help shared with api.run through
+    ``io.sanitize.QUARANTINE_METRIC`` (one constant, one series)."""
+    from .sanitize import QUARANTINE_METRIC, QUARANTINE_METRIC_HELP
+
+    return metrics.counter(QUARANTINE_METRIC, help=QUARANTINE_METRIC_HELP)
+
+
 def chunk_stream_arrays(
     X: np.ndarray,
     y: np.ndarray,
@@ -47,6 +55,7 @@ def chunk_stream_arrays(
     shuffle_seed: int | None = None,
     feature_dtype=np.float32,
     metrics=None,
+    row_valid: np.ndarray | None = None,
 ) -> Iterator[Batches]:
     """Chunk an in-memory stream; rows are global positions + start_row.
 
@@ -55,19 +64,30 @@ def chunk_stream_arrays(
     for transport-bound feeds, at the cost of bf16 feature rounding.
     ``metrics`` (a :class:`..telemetry.metrics.MetricsRegistry`) counts
     ``ingest_rows_total`` / ``ingest_chunks_total`` as the feed progresses.
+    ``row_valid`` ([n] bool — a quarantine mask from ``io.sanitize``, or
+    any caller mask) is sliced per chunk and folded into each chunk's
+    validity plane (``stripe_chunk``), so the chunked engine sees masked
+    rows as padding exactly like the one-shot path; the mask adds
+    ``ingest_quarantined_total`` to the metric set.
     """
     n, f = X.shape
     p, b, cb = partitions, per_batch, chunk_batches
     c_rows, c_chunks = _ingest_counters(metrics)
+    c_quar = None
+    if metrics is not None and row_valid is not None:
+        c_quar = _quarantine_counter(metrics)
     rows_per_chunk = p * b * cb
     for s in range(0, n, rows_per_chunk):
         e = min(s + rows_per_chunk, n)
+        rv = None if row_valid is None else row_valid[s:e]
         if c_rows is not None:
             c_rows.inc(e - s)
             c_chunks.inc()
+            if c_quar is not None:
+                c_quar.inc(int((~np.asarray(rv, bool)).sum()))
         yield stripe_chunk(
             X[s:e], y[s:e], s + start_row, p, b, cb, shuffle_seed,
-            feature_dtype=feature_dtype,
+            feature_dtype=feature_dtype, row_valid=rv,
         )
 
 
@@ -187,6 +207,8 @@ def csv_chunks(
     block_bytes: int = 16 << 20,
     feature_dtype=np.float32,
     metrics=None,
+    data_policy: str | None = None,
+    quarantine_path: str | None = None,
 ) -> Iterator[Batches]:
     """Stream a CSV file from disk as striped chunks, without materialising it.
 
@@ -207,6 +229,16 @@ def csv_chunks(
 
     ``metrics`` counts ``ingest_rows_total`` / ``ingest_chunks_total`` plus
     ``ingest_bytes_total`` (file bytes parsed) for the disk path.
+
+    ``data_policy`` (None = trusting parse, the exact historical
+    behaviour) applies the stream contract per block (``io.sanitize``):
+    ``'strict'`` raises a structured ``StreamContractError`` naming
+    file/row/column on the first violation; ``'quarantine'`` masks
+    violating rows into each chunk's validity plane (padding-identical
+    inside jit), appends them to the ``quarantine_path`` sidecar, and
+    counts ``ingest_quarantined_total``. ``'repair'`` is rejected — mean
+    imputation needs full-column statistics a single-pass stream cannot
+    have; use the one-shot loader for repair.
     """
     p, b, cb = partitions, per_batch, chunk_batches
     c_rows, c_chunks = _ingest_counters(metrics)
@@ -215,26 +247,102 @@ def csv_chunks(
         if metrics is not None
         else None
     )
+    c_quar = None
+    sanitize = None
+    writer = None
+    if data_policy is not None:
+        from . import sanitize
+
+        sanitize.check_policy(data_policy)
+        if data_policy == "repair":
+            raise ValueError(
+                "data_policy='repair' needs full-stream column statistics; "
+                "the streaming reader supports 'strict' and 'quarantine' — "
+                "use io.sanitize.load_csv_sane for repair"
+            )
+        if data_policy == "quarantine":
+            writer = sanitize.QuarantineWriter(
+                quarantine_path or (path + ".quarantine.jsonl"), data_policy
+            )
+            if metrics is not None:
+                c_quar = _quarantine_counter(metrics)
     rows_per_chunk = p * b * cb
     from .native import parse_block
 
     with open(path, "rb") as fh:
         header = fh.readline().decode().strip().split(",")
-        tcol = header.index(target_column)
+        if sanitize is not None:
+            tcol = sanitize.validate_header(header, target_column, path)
+        elif target_column not in header:
+            raise ValueError(
+                f"{path}: target column {target_column!r} not in header; "
+                f"columns found: {header}"
+            )
+        else:
+            tcol = header.index(target_column)
         cols = len(header)
         mask = np.ones(cols, bool)
         mask[tcol] = False
+        rows_parsed = 0  # absolute data-row index for sidecar records
+        rows_valid = 0  # contract-passing rows seen (all-dirty guard)
+
+        def parse(block_bytes_: bytes) -> tuple[np.ndarray, "np.ndarray | None"]:
+            """One block → (matrix, ok-mask | None), contract applied."""
+            nonlocal rows_parsed, rows_valid
+            if sanitize is None:
+                arr = parse_block(block_bytes_, cols)
+                rows_parsed += len(arr)
+                return arr, None
+            try:
+                arr = parse_block(block_bytes_, cols)
+                issues = []
+            except ValueError:
+                lines = block_bytes_.decode(errors="replace").splitlines()
+                arr, issues = sanitize.parse_rows(lines, cols)
+            issues = issues + sanitize.scan_matrix(
+                arr, tcol, header,
+                flagged=frozenset(i.row for i in issues),
+            )
+            base = rows_parsed
+            rows_parsed += len(arr)
+            arr, ok = sanitize.apply_block_policy(
+                arr, issues, path=path, policy=data_policy,
+                base_row=base, writer=writer, header=header,
+            )
+            if ok is None:
+                rows_valid += len(arr)
+            else:
+                rows_valid += int(ok.sum())
+                if c_quar is not None:
+                    c_quar.inc(int((~ok).sum()))
+            return arr, ok
 
         parts: list[np.ndarray] = []
+        ok_parts: list["np.ndarray | None"] = []
         buffered = 0
         start_row = 0
         carry = b""
 
-        def emit(arr_list, start, n_take):
-            data = np.concatenate(arr_list) if len(arr_list) > 1 else arr_list[0]
+        def emit(start, n_take):
+            data = np.concatenate(parts) if len(parts) > 1 else parts[0]
             take, rest = data[:n_take], data[n_take:]
+            ok = None
+            ok_rest = None
+            if any(o is not None for o in ok_parts):
+                ok_all = np.concatenate(
+                    [
+                        np.ones(len(a), bool) if o is None else o
+                        for a, o in zip(parts, ok_parts)
+                    ]
+                )
+                ok, ok_rest = ok_all[:n_take], ok_all[n_take:]
+                if ok.all():
+                    ok = None
+                if ok_rest is not None and not len(ok_rest):
+                    ok_rest = None
             labels = take[:, tcol]
-            if labels.size and np.abs(labels).max() >= 2**24:
+            valid_labels = labels if ok is None else labels[ok]
+            if valid_labels.size and np.abs(valid_labels).max() >= 2**24:
                 raise ValueError(
                     "label ids at or above 2^24 are not exactly representable "
                     "on the float32 parse path; re-encode the target column"
@@ -246,35 +354,57 @@ def csv_chunks(
                 p, b, cb,
                 shuffle_seed,
                 feature_dtype=feature_dtype,
+                row_valid=ok,
             )
             if c_rows is not None:
                 c_rows.inc(len(take))
                 c_chunks.inc()
-            return chunk, rest
+            return chunk, rest, ok_rest
 
-        while True:
-            block = fh.read(block_bytes)
-            if not block:
-                break
-            if c_bytes is not None:
-                c_bytes.inc(len(block))
-            block = carry + block
-            cut = block.rfind(b"\n")
-            if cut < 0:
-                carry = block
-                continue
-            carry, block = block[cut + 1:], block[: cut + 1]
-            arr = parse_block(block, cols)
-            parts.append(arr)
-            buffered += len(arr)
-            while buffered >= rows_per_chunk:
-                chunk, rest = emit(parts, start_row, rows_per_chunk)
+        try:
+            while True:
+                block = fh.read(block_bytes)
+                if not block:
+                    break
+                if c_bytes is not None:
+                    c_bytes.inc(len(block))
+                block = carry + block
+                cut = block.rfind(b"\n")
+                if cut < 0:
+                    carry = block
+                    continue
+                carry, block = block[cut + 1:], block[: cut + 1]
+                arr, ok = parse(block)
+                parts.append(arr)
+                ok_parts.append(ok)
+                buffered += len(arr)
+                while buffered >= rows_per_chunk:
+                    chunk, rest, ok_rest = emit(start_row, rows_per_chunk)
+                    yield chunk
+                    start_row += rows_per_chunk
+                    parts = [rest] if len(rest) else []
+                    ok_parts = [ok_rest] if len(rest) else []
+                    buffered = len(rest)
+            if carry:
+                arr, ok = parse(carry)
+                parts.append(arr)
+                ok_parts.append(ok)
+                buffered += len(arr)
+            if buffered:
+                chunk, _, _ = emit(start_row, buffered)
                 yield chunk
-                start_row += rows_per_chunk
-                parts, buffered = ([rest] if len(rest) else []), len(rest)
-        if carry:
-            parts.append(parse_block(carry, cols))
-            buffered += len(parts[-1])
-        if buffered:
-            chunk, _ = emit(parts, start_row, buffered)
-            yield chunk
+            # Degenerate-stream guard, matching the whole-file path
+            # (apply_policy raises the same on a fully-dirty file): a
+            # run that quarantined EVERY row must not read as success.
+            if sanitize is not None and rows_parsed and not rows_valid:
+                raise sanitize.StreamContractError(
+                    path,
+                    reason=(
+                        f"all {rows_parsed} data rows violate the stream "
+                        "contract; nothing left to quarantine around"
+                    ),
+                    total=rows_parsed,
+                )
+        finally:
+            if writer is not None:
+                writer.close()

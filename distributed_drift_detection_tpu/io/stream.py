@@ -58,6 +58,9 @@ class StreamData:
         base_X: np.ndarray | None = None,  # [T, F] f32 deduplicated row table
         base_y: np.ndarray | None = None,  # [T] i32
         src: np.ndarray | None = None,  # [N] i32: stream position → table row
+        row_ok: np.ndarray | None = None,  # [N] bool; None = every row valid
+        base_ok: np.ndarray | None = None,  # [T] bool table-row validity
+        quarantine=None,  # io.sanitize.QuarantineReport | None
     ):
         assert (X is not None and y is not None) or src is not None
         self._X = X
@@ -67,6 +70,14 @@ class StreamData:
         self.base_X = base_X
         self.base_y = base_y
         self.src = src
+        # Quarantine mask (io.sanitize): False = the row violated the
+        # stream contract and is carried *positionally* — zero content,
+        # masked at stripe time so inside jit it is indistinguishable
+        # from padding. Compressed streams store the table-row mask and
+        # expand lazily, like X/y.
+        self._row_ok = row_ok
+        self.base_ok = base_ok
+        self.quarantine = quarantine
 
     @property
     def X(self) -> np.ndarray:
@@ -81,6 +92,18 @@ class StreamData:
         return self._y
 
     @property
+    def row_ok(self) -> np.ndarray | None:
+        if self._row_ok is None and self.base_ok is not None:
+            self._row_ok = self.base_ok[self.src]
+        return self._row_ok
+
+    @property
+    def has_masked_rows(self) -> bool:
+        """True when any row is quarantine-masked (checked without
+        materializing the per-position mask of a compressed stream)."""
+        return self._row_ok is not None or self.base_ok is not None
+
+    @property
     def num_rows(self) -> int:
         return len(self.src) if self.src is not None else len(self._y)
 
@@ -93,17 +116,45 @@ def load_csv(path: str, target_column: str = "target") -> tuple[np.ndarray, np.n
     """Load a numeric CSV with a named target column.
 
     Uses the native multithreaded C++ parser (``io.native``) when available
-    — parsing-bound ingest at memory speed — with a NumPy fallback.
+    — parsing-bound ingest at memory speed — with a NumPy fallback. A
+    native-vs-header column-count disagreement is *traced* (a warning
+    naming the path and both counts) before the NumPy re-parse, and if the
+    NumPy parse disagrees with the header too the load fails loudly with
+    both counts — never a silent shape mismatch flowing downstream. For
+    the policy-aware loader (quarantine/repair of dirty rows) see
+    ``io.sanitize.load_csv_sane``.
     """
     with open(path) as fh:
         header = fh.readline().strip().split(",")
+    if target_column not in header:
+        raise ValueError(
+            f"{path}: target column {target_column!r} not in header; "
+            f"columns found: {header}"
+        )
     tcol = header.index(target_column)
 
     from .native import load_csv_native
 
     raw = load_csv_native(path)
-    if raw is None or raw.shape[1] != len(header):
-        raw = np.loadtxt(path, delimiter=",", skiprows=1, dtype=np.float32)
+    if raw is not None and raw.shape[1] != len(header):
+        import warnings
+
+        warnings.warn(
+            f"{path}: native parser returned {raw.shape[1]} column(s) but "
+            f"the header names {len(header)}; re-parsing with NumPy",
+            stacklevel=2,
+        )
+        raw = None
+    if raw is None:
+        raw = np.loadtxt(
+            path, delimiter=",", skiprows=1, dtype=np.float32, ndmin=2
+        )
+        if raw.shape[1] != len(header):
+            raise ValueError(
+                f"{path}: data rows have {raw.shape[1]} column(s) but the "
+                f"header names {len(header)} ({header}); both parsers "
+                "disagree with the header — fix the file or the header"
+            )
     mask = np.ones(len(header), bool)
     mask[tcol] = False
     return raw[:, mask], raw[:, tcol].astype(np.int64)
@@ -115,6 +166,7 @@ def synthesize_stream(
     mult_data: float = 1.0,
     seed: int = 0,
     standardize: bool = True,
+    row_ok: np.ndarray | None = None,
 ) -> StreamData:
     """Volume-scale, shuffle, sort-by-target — the C2 semantics, seeded.
 
@@ -125,29 +177,74 @@ def synthesize_stream(
     computed on the table — the duplicated stream is ``reps`` exact copies
     of it, so the moments are identical. ``mult_data < 1`` subsamples rows
     (and possibly classes), so it materializes directly.
+
+    ``row_ok`` (the quarantine mask from ``io.sanitize`` — or any
+    caller-built mask) marks rows excluded from the stream's *statistics*
+    but carried positionally: masked rows are canonicalized first
+    (``sanitize.mask_rows`` — zero features, smallest-valid-label fill,
+    so a dirty quarantined stream and a clean stream with the same rows
+    masked become byte-identical inputs here), excluded from the
+    standardization moments and the class set, and flow through the
+    duplicate/shuffle/sort like every other row — the stripers fold the
+    mask into the ``[P, NB, B]`` validity plane so inside jit they read
+    as padding. ``dist_between_changes`` keeps counting positions
+    (masked included): concept boundaries are positional facts of the
+    sorted stream, exactly as the reference's ``rows // classes``.
     """
     rng = np.random.default_rng(seed)
     n = len(y)
 
-    def _standardize(A):
+    if row_ok is not None:
+        row_ok = np.asarray(row_ok, bool)
+        if row_ok.shape != (n,):
+            raise ValueError(
+                f"row_ok shape {row_ok.shape} does not match {n} stream rows"
+            )
+        if row_ok.all():
+            row_ok = None
+        else:
+            from .sanitize import mask_rows
+
+            X, y = mask_rows(X, y, row_ok)
+
+    def _standardize(A, ok=None):
         A = np.ascontiguousarray(A, np.float32)
         if not standardize:
             return A
-        mu = A.mean(axis=0)
-        sd = A.std(axis=0)
-        return (A - mu) / np.where(sd > 0, sd, 1.0)
+        sel = A if ok is None else A[ok]
+        mu = sel.mean(axis=0)
+        sd = sel.std(axis=0)
+        # Zero-variance (or non-finite — a fully masked pathological
+        # column) moments must not NaN the whole stream: constant
+        # columns standardize to 0, not 0/0.
+        sd = np.where((sd > 0) & np.isfinite(sd), sd, np.float32(1.0))
+        mu = np.where(np.isfinite(mu), mu, np.float32(0.0))
+        out = (A - mu) / sd
+        if ok is not None:
+            out[~ok] = 0.0  # masked rows keep the canonical zero fill
+        return out
 
     if mult_data < 1.0:
         take = rng.permutation(n)[: max(1, int(round(n * mult_data)))]
         X, y = X[take], y[take]
+        ok = row_ok[take] if row_ok is not None else None
         order = np.argsort(y, kind="stable")  # :51, stable like pandas
         X, y = X[order], y[order]
+        if ok is not None:
+            ok = ok[order]
+            if ok.all():
+                ok = None
+            elif not ok.any():
+                raise ValueError(
+                    "subsampling left no valid (unmasked) rows in the stream"
+                )
         classes, y_idx = np.unique(y, return_inverse=True)
         return StreamData(
-            X=_standardize(X),
+            X=_standardize(X, ok),
             y=y_idx.astype(np.int32),
             num_classes=len(classes),
             dist_between_changes=len(y) // len(classes),
+            row_ok=ok,
         )
 
     reps = int(mult_data)
@@ -158,26 +255,52 @@ def synthesize_stream(
     return StreamData(
         num_classes=len(classes),
         dist_between_changes=len(src) // len(classes),
-        base_X=_standardize(X),
+        base_X=_standardize(X, row_ok),
         base_y=y_base.astype(np.int32),
         src=src,
+        base_ok=row_ok,
     )
 
 
 def load_stream(
-    path: str, mult_data: float = 1.0, seed: int = 0, standardize: bool = True
+    path: str,
+    mult_data: float = 1.0,
+    seed: int = 0,
+    standardize: bool = True,
+    data_policy: str | None = None,
+    quarantine_path: str | None = None,
 ) -> StreamData:
     """Dataset → prepared stream. ``path`` is a CSV file, or a ``synth:``
     spec (e.g. ``synth:rialto,seed=1`` — see ``io.synth.parse_synth``) for
     the generators standing in for the reference's missing large blobs
-    (SURVEY.md C16: ``rialto.csv``)."""
+    (SURVEY.md C16: ``rialto.csv``).
+
+    ``data_policy`` (None = legacy trusting load) routes CSV ingest
+    through the sanitizing loader (``io.sanitize.load_csv_sane``):
+    ``'strict'`` raises a structured ``StreamContractError`` on any
+    contract violation, ``'quarantine'`` drops violating rows into the
+    ``quarantine_path`` sidecar and masks them positionally,
+    ``'repair'`` imputes what it can and quarantines the rest. Synthetic
+    specs generate by construction and skip validation."""
+    row_ok = None
+    report = None
     if path.startswith("synth:"):
         from .synth import parse_synth
 
         X, y = parse_synth(path[len("synth:") :])
+    elif data_policy is not None:
+        from .sanitize import load_csv_sane
+
+        X, y, row_ok, report = load_csv_sane(
+            path, policy=data_policy, quarantine_path=quarantine_path
+        )
     else:
         X, y = load_csv(path)
-    return synthesize_stream(X, y, mult_data, seed, standardize)
+    stream = synthesize_stream(
+        X, y, mult_data, seed, standardize, row_ok=row_ok
+    )
+    stream.quarantine = report
+    return stream
 
 
 def stripe_chunk(
@@ -189,6 +312,7 @@ def stripe_chunk(
     nb: int,
     shuffle_seed: int | None = None,
     feature_dtype=np.float32,
+    row_valid: np.ndarray | None = None,
 ) -> Batches:
     """Pad + row-stripe one contiguous span of the stream into ``[P, NB, B]``.
 
@@ -215,10 +339,31 @@ def stripe_chunk(
     the plane back on device, so every driver — chunked, one-shot, mesh —
     gets f32 compute), and only the feature rounding to bf16 differs.
     Labels, rows and masks are integral and stay exact.
+
+    ``row_valid`` ([n] bool; the quarantine mask of this span,
+    ``io.sanitize``) folds into the validity plane — the engine-level
+    guard plane of the dirty-stream subsystem: a quarantined row keeps
+    its stream position but its grid slot carries the padding fill
+    (features 0.0, label 0) and ``valid == False``, so inside jit it is
+    indistinguishable from padding — static shapes, no recompiles, and
+    the detector's statistics are exactly the clean stream's with those
+    rows masked. The content re-fill here is also the numerical guard:
+    no NaN/Inf from a dirty row can cross the host→device link even if
+    a caller skipped canonicalization.
     """
     n = len(y)
     p, b = partitions, per_batch
+    if row_valid is not None:
+        row_valid = np.asarray(row_valid, bool)
+        if row_valid.shape != (n,):
+            raise ValueError(
+                f"row_valid shape {row_valid.shape} != span rows ({n},)"
+            )
+        X = np.where(row_valid[:, None], X, np.asarray(X).dtype.type(0))
+        y = np.where(row_valid, y, 0)
     gmap, rows, valid = _stripe_maps(n, start_row, p, b, nb, shuffle_seed)
+    if row_valid is not None:
+        valid = valid & _pad(row_valid, p * nb * b, False)[gmap]
     return Batches(
         X=_pad(np.asarray(X, feature_dtype), p * nb * b, 0.0)[gmap],
         y=_pad(np.asarray(y, np.int32), p * nb * b, 0)[gmap],
@@ -303,11 +448,14 @@ def stripe_partitions(
     Returns :class:`Batches` with leading partition axis: ``X [P, NB, B, F]``,
     ``y/rows/valid [P, NB, B]``. ``rows`` holds global stream positions so the
     delay metric (global position % concept length) works per the reference's
-    intent. ``shuffle_seed``: see :func:`stripe_chunk`.
+    intent. ``shuffle_seed``: see :func:`stripe_chunk`. Quarantined rows
+    (``stream.row_ok``) fold into the validity plane (:func:`stripe_chunk`'s
+    ``row_valid``).
     """
     _, nb = stripe_geometry(stream.num_rows, partitions, per_batch)
     return stripe_chunk(
-        stream.X, stream.y, 0, partitions, per_batch, nb, shuffle_seed
+        stream.X, stream.y, 0, partitions, per_batch, nb, shuffle_seed,
+        row_valid=stream.row_ok,
     )
 
 
@@ -362,6 +510,16 @@ def stripe_partitions_packed(
         raise ValueError(
             "stream has no compressed form (subsampled or hand-built); "
             "use stripe_partitions"
+        )
+    if stream.has_masked_rows:
+        # The packed form synthesizes `valid` in-jit from pure geometry
+        # (expand_packed: gmap < n) — a quarantine mask is data, not
+        # geometry, so masked streams ride the dense striper where the
+        # mask folds into the host-built validity plane (api.prepare
+        # routes them there; flags are bit-identical across stripers).
+        raise ValueError(
+            "stream has quarantine-masked rows; the packed striper cannot "
+            "carry a row mask — use stripe_partitions"
         )
     n = stream.num_rows
     p, b = partitions, per_batch

@@ -28,6 +28,14 @@ Sites (each named where the production code calls :func:`fire`):
                        ``kind='torn_write'`` appends a partial JSON prefix
                        (no newline) before raising: the torn-tail shape
                        ``read_events(allow_partial_tail=True)`` tolerates
+``stream.load``        inside the sanitizing CSV loader
+                       (``io.sanitize.load_csv_sane``) — the home of the
+                       **data-corruption kinds** ``nan_cell`` /
+                       ``bad_label`` / ``ragged_row``, which mutate the
+                       raw CSV text lines deterministically instead of
+                       raising, so the dirty-stream machinery (doctor,
+                       quarantine, repair) is exercised by the same
+                       seeded injection the process faults use
 =====================  ====================================================
 
 Arming is explicit (:func:`arm` in-process, or the ``DDD_FAULTS`` env var
@@ -63,7 +71,15 @@ class InjectedTimeout(InjectedFault):
 
 ENV_VAR = "DDD_FAULTS"
 
-KINDS = ("raise", "timeout", "torn_write")
+KINDS = ("raise", "timeout", "torn_write", "nan_cell", "bad_label", "ragged_row")
+
+# Data-corruption kinds: instead of raising, a firing mutates the CSV text
+# lines the ``stream.load`` site hands in — ``times`` is reinterpreted as
+# *rows corrupted per firing* (default 1), and a positionally-armed spec
+# fires on every load from ``at`` onward (the corruption is deterministic,
+# so repeated loads corrupt identically). Only meaningful at sites that
+# pass ``lines=``; elsewhere a corruption-kind firing is a no-op.
+CORRUPTION_KINDS = frozenset({"nan_cell", "bad_label", "ragged_row"})
 
 # Every site a production call point declares; arming anything else is a
 # typo and fails loudly (the silent-no-op failure mode of a misspelled
@@ -76,6 +92,7 @@ SITES = frozenset(
         "soak.leg",
         "checkpoint.save",
         "telemetry.emit",
+        "stream.load",
     }
 )
 
@@ -96,6 +113,17 @@ class FaultSpec:
     fired: int = 0  # faults actually raised
 
     def should_fire(self) -> bool:
+        if self.kind in CORRUPTION_KINDS:
+            # Corruption kinds: `times` means rows-per-firing, not
+            # consecutive-firing count — positional arming fires on every
+            # hit from `at` onward (deterministic, so re-loads corrupt
+            # identically); Bernoulli arming decides per hit as usual.
+            if self.at:
+                return self.hits >= self.at
+            return (
+                self.rate > 0.0
+                and _unit_interval(self.seed, self.site, self.hits) < self.rate
+            )
         if self.at:
             if self.hits < self.at:
                 return False
@@ -194,7 +222,64 @@ def arm_from_env(spec: str | None = None) -> list[str]:
     return sites
 
 
-def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = None, **context) -> None:
+def corrupt_lines(
+    lines: list[str],
+    kind: str,
+    *,
+    rows: int = 1,
+    seed: int = 0,
+    label_col: int = -1,
+) -> list[tuple[int, int]]:
+    """Deterministically corrupt ``rows`` distinct CSV data lines in place.
+
+    ``kind='nan_cell'`` replaces one seeded cell with ``nan`` (a
+    non-finite value the contract scan flags); ``'bad_label'`` makes the
+    ``label_col`` field non-integral (``<y>.5``); ``'ragged_row'`` drops
+    the last field. Row/column choices hash ``(seed, kind, k)`` — no
+    global RNG, no wall-clock — and collisions probe linearly, so a given
+    arming corrupts the same cells in every run. Returns the corrupted
+    ``(row, column)`` pairs (column −1 for ragged rows). Also usable
+    directly (the ``dirty-stream-smoke`` CI job corrupts a CSV copy with
+    it); :func:`fire` routes ``stream.load`` firings here.
+    """
+    if kind not in CORRUPTION_KINDS:
+        raise ValueError(
+            f"unknown corruption kind {kind!r}; expected one of "
+            f"{sorted(CORRUPTION_KINDS)}"
+        )
+    n = len(lines)
+    if n == 0:
+        return []
+    out: list[tuple[int, int]] = []
+    used: set[int] = set()
+    for k in range(min(max(rows, 1), n)):
+        r = int(_unit_interval(seed, f"{kind}.row", k) * n) % n
+        while r in used:
+            r = (r + 1) % n
+        used.add(r)
+        fields = lines[r].split(",")
+        if kind == "ragged_row":
+            fields = fields[:-1] if len(fields) > 1 else fields + ["0"]
+            out.append((r, -1))
+        elif kind == "bad_label":
+            c = label_col % len(fields)
+            try:
+                base = int(float(fields[c]))
+            except ValueError:
+                base = 0
+            fields[c] = f"{base}.5"
+            out.append((r, c))
+        else:  # nan_cell
+            c = int(_unit_interval(seed, f"{kind}.col", k) * len(fields)) % len(
+                fields
+            )
+            fields[c] = "nan"
+            out.append((r, c))
+        lines[r] = ",".join(fields)
+    return out
+
+
+def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = None, lines: "list[str] | None" = None, label_col: int = -1, **context) -> None:
     """Production-code hook: a no-op unless ``site`` is armed and its spec
     elects this hit. When it fires:
 
@@ -204,6 +289,11 @@ def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = N
       finish*: with ``fh``+``payload`` (the telemetry sink) append the
       first half of the payload with no newline; with ``file`` (the
       checkpoint temp file) truncate it to half its bytes; then raise.
+    * corruption kinds (``nan_cell``/``bad_label``/``ragged_row``) —
+      mutate ``lines`` (raw CSV data lines, no header) in place via
+      :func:`corrupt_lines` and return **without raising**: the dirt
+      flows through the sanitizing loader like real dirt would.
+      ``label_col`` tells ``bad_label`` which field is the target.
 
     ``context`` rides into the exception message for post-mortems.
     """
@@ -216,6 +306,16 @@ def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = N
     if not spec.should_fire():
         return
     spec.fired += 1
+    if spec.kind in CORRUPTION_KINDS:
+        if lines is not None:
+            corrupt_lines(
+                lines,
+                spec.kind,
+                rows=max(spec.times, 1),
+                seed=spec.seed,
+                label_col=label_col,
+            )
+        return
     detail = f"injected fault at {site!r} (hit {spec.hits})"
     if context:
         detail += " " + " ".join(f"{k}={v!r}" for k, v in sorted(context.items()))

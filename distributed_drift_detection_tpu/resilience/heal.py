@@ -92,6 +92,7 @@ def spec_configs(spec: dict) -> list[RunConfig]:
         per_batch=int(spec["per_batch"]),
         seed=int(spec["seed"]),
         results_csv=spec["results_csv"],
+        data_policy=str(spec["data_policy"]),
     )
     configs = grid_configs(
         base,

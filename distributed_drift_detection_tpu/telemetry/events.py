@@ -72,6 +72,14 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # of a device snapshot means the backend reports none (XLA CPU) —
     # never a fabricated zero.
     "memory_snapshot": ("source", "stats"),
+    # ingest quarantine (io.sanitize via api.run): ``rows`` stream rows
+    # violated the ingest contract and were masked out under ``policy``
+    # ('quarantine'/'repair'); the per-row evidence lives in the
+    # quarantine.jsonl sidecar (its path rides as the ``sidecar`` extra,
+    # repaired-cell count as ``repaired``). Emitted between prepare and
+    # the Final Time span — outside the timed region — and only when the
+    # count is nonzero: clean streams leave no trace.
+    "rows_quarantined": ("rows", "policy"),
     # supervised retry (resilience.supervisor): attempt ``attempt`` of
     # ``max_attempts`` failed with ``reason`` (the classified exception,
     # as "Type: message") and will be re-run after ``backoff_s`` seconds.

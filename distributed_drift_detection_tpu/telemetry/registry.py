@@ -181,6 +181,9 @@ def newest_run_log(telemetry_dir: str) -> str | None:
         for p in glob.glob(os.path.join(telemetry_dir, "*.jsonl"))
         if os.path.basename(p) != INDEX_NAME
         and os.path.basename(p) not in registered
+        # quarantine sidecars (io.sanitize) live next to their run log
+        # but are row records, not event logs — never "the newest run"
+        and not os.path.basename(p).endswith("quarantine.jsonl")
     ]
     best_unreg: "tuple[float, str] | None" = None
     if unregistered:

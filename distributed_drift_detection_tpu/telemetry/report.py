@@ -44,6 +44,7 @@ def summarize(events: list[dict]) -> dict:
         "chunks": [],
         "legs": [],
         "retried": [],
+        "quarantine": None,
         "heartbeat": None,
         "completed": None,
         "cost": None,
@@ -72,6 +73,8 @@ def summarize(events: list[dict]) -> dict:
             s["legs"].append(e)
         elif t == "run_retried":
             s["retried"].append(e)
+        elif t == "rows_quarantined":
+            s["quarantine"] = e
         elif t == "heartbeat":
             s["heartbeat"] = e  # newest wins: the run's latest known pulse
         elif t == "cost_analysis":
@@ -264,6 +267,17 @@ def render_report(events: list[dict]) -> str:
                 "  "
                 + "  ".join(f"p{q}:{per_part[q]}" for q in parts[i : i + 8])
             )
+    if s["quarantine"] is not None:
+        q = s["quarantine"]
+        line = (
+            f"quarantine {int(q['rows'])} row(s) masked out "
+            f"(data_policy={q['policy']})"
+        )
+        if q.get("repaired"):
+            line += f", {int(q['repaired'])} cell-repaired row(s)"
+        if q.get("sidecar"):
+            line += f"  sidecar {q['sidecar']}"
+        out.append(line)
     if s["retrains"]:
         out.append(
             f"retrains   {s['retrains']}  ({s['forced_retrains']} forced "

@@ -73,6 +73,59 @@ def checked_ddm_window(
     return checkify.checkify(f)(state, errs, valid)
 
 
+def validate_stream(stream) -> None:
+    """Host-side ingest audit of a prepared ``io.stream.StreamData``.
+
+    The promotion of the in-jit checkify contract to a run-level switch
+    (``RunConfig(validate=True)`` — ``api.prepare`` calls this before any
+    device work): every *valid* row's features must be finite, labels in
+    ``0..C-1``, and the quarantine mask (when present) shape-aligned.
+    Masked rows are exempt by definition — they never reach compute —
+    which is exactly what the dirty-stream subsystem promises. Raises
+    ``ValueError`` naming the first offending stream position.
+    """
+    # Audit the table for compressed streams (every stream row is a table
+    # gather, so a finite table is a finite stream) — the dense planes
+    # otherwise. The mask audited alongside is the matching one (table
+    # mask for tables), so no [N] mask ever materializes here.
+    if stream.src is not None:
+        X, y = stream.base_X, stream.base_y
+        t_ok = stream.base_ok
+    else:
+        X, y = stream.X, stream.y
+        t_ok = stream.row_ok
+    if t_ok is not None:
+        t_ok = np.asarray(t_ok, bool)
+        if t_ok.shape != (len(y),):
+            raise ValueError(
+                f"stream validation failed: row mask shape {t_ok.shape} "
+                f"!= ({len(y)},)"
+            )
+        if not t_ok.any():
+            raise ValueError(
+                "stream validation failed: every row is masked"
+            )
+    sel_X = X if t_ok is None else X[t_ok]
+    sel_y = y if t_ok is None else y[t_ok]
+    if not np.isfinite(sel_X).all():
+        bad = ~np.isfinite(np.asarray(X)).all(axis=1)
+        if t_ok is not None:
+            bad &= t_ok
+        rows = np.nonzero(bad)[0]
+        raise ValueError(
+            "stream validation failed: non-finite feature value(s) in "
+            f"valid row(s) {rows[:5].tolist()}"
+        )
+    if sel_y.size and (
+        (sel_y < 0).any() or (sel_y >= max(stream.num_classes, 1)).any()
+    ):
+        bad = sel_y[(sel_y < 0) | (sel_y >= max(stream.num_classes, 1))]
+        raise ValueError(
+            "stream validation failed: label(s) outside 0.."
+            f"{stream.num_classes - 1}: {bad[:5].tolist()}"
+        )
+
+
 def validate_flag_rows(
     flags, num_batches: int, per_batch: int, num_rows: int
 ) -> None:

@@ -43,6 +43,7 @@ EXAMPLE_EVENTS = {
     "memory_snapshot": dict(
         source="memory_analysis", stats={"temp_bytes": 14_401_584}
     ),
+    "rows_quarantined": dict(rows=3, policy="quarantine"),
     "run_retried": dict(
         attempt=1, max_attempts=3, reason="RuntimeError: device lost",
         backoff_s=0.55,
