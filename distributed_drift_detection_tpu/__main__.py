@@ -21,7 +21,11 @@ exports (telemetry subsystem).
 
 A fourth flag, ``--data-policy {strict,quarantine,repair}``, selects the
 ingest contract policy for dirty CSVs (``io.sanitize``; strict is the
-default — fail loudly, never compute on garbage).
+default — fail loudly, never compute on garbage). ``--compile-cache-dir
+DIR`` points jax's persistent compilation cache at DIR (warm-start:
+repeated invocations skip XLA compilation — ``utils.compile_cache``), and
+``--collect {compact,full}`` pins the collect-phase transport
+(device-compacted detection table vs full flag plane; flags identical).
 
 Six further subcommands work offline (no accelerator — ``doctor`` reads
 the data, the rest just the artifacts; ``heal --execute`` is the one that
@@ -55,6 +59,7 @@ _USAGE = (
     "usage: python -m distributed_drift_detection_tpu "
     "[--trace-dir DIR] [--profile-dir DIR] [--telemetry-dir DIR] "
     "[--data-policy strict|quarantine|repair] "
+    "[--compile-cache-dir DIR] [--collect compact|full] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
@@ -139,6 +144,19 @@ def main(argv: list[str]) -> None:
                 f"{'|'.join(DATA_POLICIES)}, got {data_policy!r})"
             )
         kw["data_policy"] = data_policy
+    compile_cache_dir = _pop_flag(argv, "--compile-cache-dir")
+    if compile_cache_dir is not None:
+        kw["compile_cache_dir"] = compile_cache_dir
+    collect = _pop_flag(argv, "--collect")
+    if collect is not None:
+        from .config import COLLECT_MODES
+
+        if collect not in COLLECT_MODES:
+            raise SystemExit(
+                f"{_USAGE}\n(--collect must be one of "
+                f"{'|'.join(COLLECT_MODES)}, got {collect!r})"
+            )
+        kw["collect"] = collect
     if argv and len(argv) not in (6, 7):
         raise SystemExit(_USAGE)
     if argv:

@@ -252,6 +252,11 @@ DETECTOR_NAMES = (
 # validate without importing the io package, which pulls in jax).
 DATA_POLICIES = ("strict", "quarantine", "repair")
 
+# Valid RunConfig.collect values (parallel/mesh.py collect epilogue):
+# 'compact' ships the device-compacted detection table, 'full' the packed
+# [5,P,NB-1] flag plane. Flags are bit-identical either way (tested).
+COLLECT_MODES = ("compact", "full")
+
 
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
@@ -362,6 +367,34 @@ class RunConfig:
     # concepts one window spans; config.auto_rotations — co-tuned with
     # auto_window so the defaults land on the measured W×R optimum).
     window_rotations: int = 0
+    # Collect-phase transport (parallel/mesh.py): 'compact' (default) fuses
+    # a segment-compaction epilogue into the detect program — the device
+    # returns a small dense detection table (partition, batch, flag values;
+    # fixed capacity, sentinel fill, embedded event counter) and the host
+    # reconstructs the full flag table from it, so the latency-bound d2h
+    # collect ships O(detections) bytes instead of the whole packed
+    # [5, P, NB-1] plane. 'full' keeps the round-5 full-plane path — the
+    # escape hatch for parity A/Bs; ``validate=True`` forces it too (the
+    # structural audit wants the plane the device actually produced, not a
+    # reconstruction). Flags are bit-identical across modes (tested); a
+    # table overflow (more flagged slots than capacity) falls back to the
+    # full plane loudly (RuntimeWarning), never truncates.
+    collect: str = "compact"
+    # Compacted-table capacity in entries (0 = auto: sized from the stripe
+    # geometry, parallel.mesh.auto_compact_capacity — ~P·NB/8 slots, the
+    # point where the table is still ~6× smaller than the plane while
+    # overflow needs >12.5% of all slots flagged). Explicit values exist
+    # for overflow tests and for streams known to flag densely.
+    collect_capacity: int = 0
+    # Persistent XLA compilation cache directory ('' = off). When set,
+    # compiled executables are cached across *processes* (jax
+    # jax_compilation_cache_dir), so repeated sweep cells and restarted
+    # soak legs skip compilation entirely; api.prepare additionally
+    # AOT-compiles the runner against the stripe geometry
+    # (jit.lower().compile()) so even a cold process pays the compile in
+    # the prepare phase, never inside the Final Time span. CLI:
+    # --compile-cache-dir; bench.py defaults to its own .jax_cache.
+    compile_cache_dir: str = ""
     # (Two rejected-by-measurement alternatives are documented in PARITY.md:
     # a `ddm_kernel='pallas'` fused kernel — ~78× slower than the XLA
     # lowering, removed in round 2 ("Pallas post-mortem") — and a

@@ -39,6 +39,34 @@ class ChunkResult(NamedTuple):
     chunk_index: int
 
 
+# One-time, process-wide ignore of jax's "Some donated buffers were not
+# usable" warning: the donated chunk planes mostly cannot alias the (much
+# smaller) flag outputs, so jax flags them per dispatch — but the donation
+# still frees them at consumption, which is the point (documented trade).
+# Installed at MODULE IMPORT, not per construction or per feed: a filter
+# installed inside a running test is discarded by pytest's per-test
+# warning-state save/restore (leaving a "was installed" latch stale), and
+# a per-feed warnings.catch_warnings context mutates process-global state
+# on the hot path and is not thread-safe against the prefetch producer
+# thread. Import happens once, outside any test context, so this survives.
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+del _warnings
+
+
+# Default chunk-group size for host-side flag collection on a *telemetered*
+# drain (ChunkedDetector.run collect_every=0): before r06, per-chunk
+# telemetry collected each chunk's flag table host-side as a side effect,
+# which bounded the device-resident backlog at one chunk; the scalar-count
+# events keep the tables deferred, so this group bound replaces it — small
+# enough that a long stream never accumulates unbounded device flags, large
+# enough that the dispatch queue stays full across the group.
+DEFAULT_TELEMETRY_COLLECT_EVERY = 8
+
+
 class ChunkedDetector:
     """Stateful driver around the jitted per-chunk scan.
 
@@ -61,6 +89,7 @@ class ChunkedDetector:
         detector=None,
         rotations: int = 1,
         validate: bool = False,
+        donate: bool = True,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -136,7 +165,21 @@ class ChunkedDetector:
         # ``mesh``: shard the partition axis over devices, exactly like the
         # one-shot mesh runner (parallel.mesh) — every carry/chunk/flag leaf
         # is partition-major, so one sharding prefix covers the trees.
+        #
+        # ``donate`` (default True): donate the carry AND the stale input
+        # chunk to each per-chunk dispatch (``donate_argnums``) — the old
+        # carry is dead the moment the new one exists (out-carry aliases
+        # in-carry buffer-for-buffer, so XLA updates state in place instead
+        # of allocating a second copy), and a chunk's device buffers are
+        # dead once its scan consumed them (freed immediately instead of
+        # lingering until Python GC, which is what lets the double-buffered
+        # feed keep exactly two chunks resident at any queue depth). Flags
+        # are bit-identical either way (tested). Caveat: donation consumes
+        # the DEVICE buffers passed in — feeders yield numpy-backed chunks
+        # (the host copy is untouched), but a caller feeding jax arrays it
+        # wants to reuse must pass ``donate=False``.
         self._sharding = None
+        donate_kw = {"donate_argnums": (0, 1)} if donate else {}
         if mesh is not None:
             from ..models.base import require_shardable
             from ..parallel.mesh import partition_sharding
@@ -148,9 +191,10 @@ class ChunkedDetector:
                 jax.vmap(run_chunk),
                 in_shardings=(self._sharding, self._sharding),
                 out_shardings=(self._sharding, self._sharding),
+                **donate_kw,
             )
         else:
-            self._run_chunk = jax.jit(jax.vmap(run_chunk))
+            self._run_chunk = jax.jit(jax.vmap(run_chunk), **donate_kw)
         # ``validate=True``: audit the concatenated flag table at the end
         # of :meth:`run` with the same structural checks the one-shot
         # path runs under RunConfig(validate=True)
@@ -187,12 +231,30 @@ class ChunkedDetector:
             key=init_keys[:, 0],
         )
 
+    def place(self, chunk: Batches) -> Batches:
+        """Dispatch the host→device upload of a chunk (async, non-blocking).
+
+        The double-buffer half of the pipeline: :meth:`run` places chunk
+        k+1 right after dispatching chunk k's compute, so the upload
+        overlaps the detect scan and the dispatch queue never drains
+        between chunks. Idempotent — :meth:`feed` places too, and placing
+        an already-placed chunk is a no-op — so callers may use either
+        surface. With ``donate=True`` the returned device buffers are
+        consumed by the feed that processes them.
+        """
+        if self._sharding is not None:
+            return jax.device_put(chunk, self._sharding)
+        return jax.tree.map(jnp.asarray, chunk)
+
     def feed(self, chunk: Batches) -> FlagRows:
         """Process one ``[P, CB, B]`` chunk; returns flags ``[P, CB']``.
 
         The first chunk loses its first microbatch to ``batch_a`` seeding.
         Does not block: results are JAX async values, so the caller can
-        prefetch/construct the next chunk while the device runs.
+        prefetch/construct the next chunk while the device runs. With
+        ``donate=True`` (the default) the carry and the chunk's device
+        buffers are donated to the dispatch — pass numpy-backed chunks
+        (feeders do) or chunks you won't reuse; see ``__init__``.
         """
         import time
 
@@ -209,10 +271,7 @@ class ChunkedDetector:
         self.rows_done += int(
             chunk.y.shape[0] * chunk.y.shape[1] * chunk.y.shape[2]
         )
-        if self._sharding is not None:
-            chunk = jax.device_put(chunk, self._sharding)
-        else:
-            chunk = jax.tree.map(jnp.asarray, chunk)
+        chunk = self.place(chunk)  # no-op for pre-placed (run()) chunks
         if self.carry is None:
             self.carry = self._init_carry(chunk)
             chunk = jax.tree.map(lambda x: x[:, 1:], chunk)
@@ -237,19 +296,25 @@ class ChunkedDetector:
     def emit_chunk_event(
         self, telemetry, chunk: int, flags: FlagRows, metrics=None
     ):
-        """Collect one chunk's flags host-side and emit its
-        ``chunk_completed`` progress event; returns ``(collected flags,
-        the chunk's detection count)``.
+        """Emit one chunk's ``chunk_completed`` progress event; returns
+        ``(flags, the chunk's detection count)``.
 
         Shared by :meth:`run` and feed-level drivers (e.g. the
         ``examples/unbounded_stream.py`` checkpoint-mid-stream loop) so the
         event payload — including the detection count — is engine-defined
-        everywhere. The ``np.asarray`` forces the chunk's device→host sync
-        — the opt-in observability trade. ``metrics`` (a
+        everywhere. The count is reduced DEVICE-side and only the scalar
+        crosses the device→host link: the event waits for the chunk's
+        compute (the progress beacon must describe completed work —
+        heartbeat/watch behavior is unchanged) but the flag table itself
+        stays deferred on device, so per-chunk telemetry no longer forces
+        the full-table transfer that previously made it a bandwidth trade.
+        ``flags`` is returned as given (host callers still work — the
+        reduction is array-library agnostic). ``metrics`` (a
         :class:`..telemetry.metrics.MetricsRegistry`) additionally records
         the per-chunk device-memory gauges.
         """
-        flags = jax.tree.map(np.asarray, flags)
+        # jnp on device flags → device reduce + scalar transfer; plain
+        # numpy reduce for already-collected tables.
         detections = int((flags.change_global >= 0).sum())
         telemetry.emit(
             "chunk_completed",
@@ -287,32 +352,74 @@ class ChunkedDetector:
         progress=None,
         telemetry=None,
         metrics=None,
+        collect_every: int = 0,
     ) -> FlagRows:
         """Drain an iterator of chunks; concatenates flags on host.
 
+        The drain is double-buffered: chunk k+1's host→device upload
+        (:meth:`place`) is dispatched immediately after chunk k's compute,
+        so upload overlaps detect and the dispatch queue never drains
+        between chunks; with ``donate=True`` the stale chunk's buffers are
+        reclaimed as each dispatch consumes them, bounding device memory
+        at two chunks regardless of queue depth.
+
+        ``collect_every`` sets the chunk-group boundary at which
+        accumulated flag tables are collected host-side: the only full
+        device syncs of the drain then happen every N chunks instead of
+        implicitly at the final concat — bounding the device-resident
+        backlog on very long streams without paying a per-chunk
+        round-trip. 0 (the default) means: never for an untelemetered
+        drain (unchanged — that path always deferred everything to the
+        final concat), and a bounded default group
+        (``DEFAULT_TELEMETRY_COLLECT_EVERY``) for a telemetered one —
+        before r06, per-chunk telemetry collected every table host-side
+        as a side effect, so long telemetered streams relied on that for
+        their device-memory bound; the default group keeps the bound
+        without reintroducing the per-chunk transfer. Flags are
+        bit-identical at any grouping (tested).
+
         ``telemetry`` (a :class:`..telemetry.events.EventLog`) emits one
         ``chunk_completed`` progress event per chunk (detection count
-        extracted from that chunk's collected flag table) followed by one
-        ``heartbeat`` (rows fed + monotonic elapsed — the ``watch`` CLI's
-        liveness signal). The flag extraction forces the chunk's
-        device→host sync at chunk granularity
-        — the opt-in observability trade; without telemetry the host copy
-        stays deferred to the final concat and nothing here synchronizes.
-        ``metrics`` records the per-chunk device-memory gauges (no sync —
-        usable with or without the event log).
+        reduced device-side — a scalar transfer, the flag table stays
+        deferred to the group boundary) followed by one ``heartbeat``
+        (rows fed + monotonic elapsed — the ``watch`` CLI's liveness
+        signal). ``metrics`` records the per-chunk device-memory gauges
+        (no sync — usable with or without the event log).
         """
+        if not collect_every and telemetry is not None:
+            collect_every = DEFAULT_TELEMETRY_COLLECT_EVERY
         start_batches = self.batches_done
         out = []
-        for i, chunk in enumerate(chunks):
-            flags = self.feed(chunk)
+        uncollected = 0  # trailing entries of `out` still device-resident
+
+        def _drain_group():
+            nonlocal uncollected
+            for j in range(len(out) - uncollected, len(out)):
+                out[j] = jax.tree.map(np.asarray, out[j])
+            uncollected = 0
+
+        it = iter(chunks)
+        nxt = next(it, None)
+        placed = self.place(nxt) if nxt is not None else None
+        i = 0
+        while placed is not None:
+            flags = self.feed(placed)
+            # Double-buffer: dispatch chunk k+1's upload (and pay its host
+            # parse/stripe cost) while chunk k computes.
+            nxt = next(it, None)
+            placed = self.place(nxt) if nxt is not None else None
             if telemetry is not None:
                 flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
                 self.emit_heartbeat(telemetry)
             elif metrics is not None:
                 self.record_memory_gauges(metrics)
-            out.append(flags)  # async unless telemetry collected it above
+            out.append(flags)  # async; collected at group boundaries/the end
+            uncollected += 1
+            if collect_every and uncollected >= collect_every:
+                _drain_group()
             if progress is not None:
                 progress(i, self.batches_done)
+            i += 1
         host = [jax.tree.map(np.asarray, f) for f in out]
         flags = FlagRows(*(np.concatenate(xs, axis=1) for xs in zip(*host)))
         if self.validate and self._per_batch is not None:

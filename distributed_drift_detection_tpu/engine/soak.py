@@ -394,6 +394,7 @@ def _make_soak_chain_impl(
     features: int | None = None,
     detector=None,
     mesh=None,
+    donate: bool = False,
 ):
     """Build the state-carrying chained soak (impl form — use
     :func:`make_soak_chain` for the bound ``(first_leg, next_leg)`` pair).
@@ -433,6 +434,14 @@ def _make_soak_chain_impl(
     device synthesises only its own partitions' rows; state and flag
     outputs come back partition-sharded, so the carried chain state never
     gathers to one device between legs).
+
+    ``donate`` donates the incoming chain state to each ``next_leg``
+    dispatch (``donate_argnums``): the output state aliases it leaf-for-
+    leaf, so the carried pytree is updated in place instead of doubling
+    per leg. Off by default on this impl surface — a caller holding the
+    public :func:`make_soak_chain` pair may legitimately reuse a state
+    (A/B two continuations) — and on in :func:`run_soak_chained`, whose
+    driver provably consumes each state exactly once.
     """
     try:
         gen, default_f = _GENERATORS[generator]
@@ -533,9 +542,19 @@ def _make_soak_chain_impl(
     # Every output leaf carries a leading partition axis, so one sharding
     # broadcasts as the out_shardings prefix for the whole SoakLegFlags tree.
     jit_kw = {} if sh is None else {"out_shardings": sh}
+    # Only the state is donated — leg_idx is a scalar and block0s is the
+    # shared offset vector reused by every leg. Donation is single-device
+    # only for now: with a mesh, XLA's input/output aliasing pass rejects
+    # the sharded rank-2 PRNG-key-data leaves of the carried state
+    # ("tile assignment dimensions != input rank", jax 0.4.x), so sharded
+    # chains keep the copy-on-carry semantics — the donation win targets
+    # the single-chip bench path, where the whole state is one device's.
+    next_kw = dict(jit_kw)
+    if donate and sh is None:
+        next_kw["donate_argnums"] = (0,)
     return _SoakChainImpl(
         first=jax.jit(first_leg_impl, **jit_kw),
-        next=jax.jit(next_leg_impl, **jit_kw),
+        next=jax.jit(next_leg_impl, **next_kw),
         block0s=block0s,
     )
 
@@ -639,6 +658,9 @@ def run_soak_chained(
     checkpoint_path: str = "",
     telemetry=None,
     metrics=None,
+    donate: bool = True,
+    collect_every: int = 1,
+    compile_cache_dir: str = "",
 ) -> ChainedSoakSummary:
     """Host driver over :func:`make_soak_chain`: run ≥ ``total_rows`` rows.
 
@@ -689,6 +711,26 @@ def run_soak_chained(
     sync, no-op where the backend reports nothing; it does run inside
     ``exec_time_s`` (the driver's own per-leg d2h already syncs there) —
     the same opt-in observability trade as ``telemetry``.
+
+    ``donate`` (default True) donates the carried chain state to each leg
+    dispatch — the state is updated in place on device instead of doubled
+    per leg; this driver consumes each state exactly once (the checkpoint
+    copies to host *before* the next dispatch), so donation is safe here
+    where it is opt-in on the raw :func:`make_soak_chain` surface. Flags
+    are bit-identical either way (tested).
+
+    ``collect_every`` (default 1 = the historical per-leg cadence) defers
+    the host-side flag folding — and with it the per-leg device sync, the
+    ``on_leg``/telemetry deliveries and the checkpoint write — to every
+    N-th leg boundary (and always the last), so the dispatch queue stays
+    full across a group of legs. Deliveries inside a group arrive in leg
+    order at the boundary; a crash mid-group resumes from the last group
+    boundary (the at-least-once contract, with the group as the unit).
+
+    ``compile_cache_dir`` points jax's persistent compilation cache at the
+    directory (``utils.compile_cache``) before the legs AOT-compile, so a
+    *restarted* chain — the checkpoint-resume path — skips XLA compilation
+    entirely ('' = leave the process's cache config as is).
     """
     import math
     import os
@@ -697,6 +739,11 @@ def run_soak_chained(
     import numpy as np
 
     from ..utils.checkpoint import load_checkpoint, save_checkpoint
+
+    if compile_cache_dir:
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(compile_cache_dir)
 
     b, p, de = int(per_batch), int(partitions), int(drift_every)
     # Leg length in batches: smallest multiple of the concept alignment
@@ -725,6 +772,7 @@ def run_soak_chained(
         features=features,
         detector=detector,
         mesh=mesh,
+        donate=donate,
     )
     if key is None:
         key = jax.random.key(0)
@@ -795,6 +843,58 @@ def run_soak_chained(
     start = time.perf_counter()
     hb_start = time.monotonic()  # heartbeat clock: step-proof liveness
     out = None
+    group = max(int(collect_every), 1)
+    pending: list = []  # (leg_idx, SoakLegFlags) awaiting the group boundary
+
+    def _fold_pending():
+        """Group-boundary host work: fold each pending leg's flags into the
+        detection stats and fire its observers, in leg order — the only
+        device syncs of the drive loop."""
+        nonlocal detections
+        for ls, lo in pending:
+            cg = np.asarray(lo.flags.change_global)
+            hit = cg[cg >= 0]
+            detections += int(hit.size)
+            if hit.size:
+                delays.append(hit.astype(np.int64) % de)
+            # Observer BEFORE the checkpoint marks the group complete: a
+            # crash inside on_leg re-runs the group on resume and delivers
+            # its flags again (at-least-once; a post-checkpoint crash would
+            # silently drop them, as the checkpoint does not carry flag
+            # tables). change_global is handed over host-converted (the
+            # driver already paid that d2h for its own folding) so
+            # observers reading it don't re-transfer inside the span.
+            if on_leg is not None:
+                on_leg(ls, lo.flags._replace(change_global=cg))
+            if telemetry is not None:
+                # rows counts the leg's full consumption (leg 0's batch_a
+                # seed included), so legs sum to the summary's
+                # rows_processed.
+                telemetry.emit(
+                    "leg_completed", leg=ls, rows=p * L * b,
+                    detections=int(hit.size),
+                )
+                # rows_done is stream-absolute ((s+1) whole legs, resumed
+                # ones included); elapsed is this process's monotonic span
+                # — see the docstring for why the pair is safe across
+                # resumes.
+                telemetry.emit(
+                    "heartbeat",
+                    rows_done=(ls + 1) * p * L * b,
+                    elapsed_s=time.monotonic() - hb_start,
+                    leg=ls,
+                )
+            if metrics is not None:
+                from ..telemetry.profile import (
+                    device_memory_stats,
+                    record_device_memory_gauges,
+                )
+
+                record_device_memory_gauges(
+                    metrics, device_memory_stats(), when="leg"
+                )
+        pending.clear()
+
     for s in range(start_leg, S):
         # Fault-injection site (resilience.faults; no-op unless armed):
         # kill the chain before leg `s` executes — the kill-and-resume
@@ -804,51 +904,22 @@ def run_soak_chained(
         if s == 0:
             out = first_c(key, impl.block0s)
         else:
+            # With donate=True the incoming state is consumed here — it
+            # was either just produced (and checkpoint-copied at the last
+            # boundary) or loaded from the checkpoint, never reused.
             out = next_c(
                 (state if out is None else out.state), jnp.int32(s), impl.block0s
             )
-        cg = np.asarray(out.flags.change_global)
-        hit = cg[cg >= 0]
-        detections += int(hit.size)
-        if hit.size:
-            delays.append(hit.astype(np.int64) % de)
-        # Observer BEFORE the checkpoint marks the leg complete: a crash
-        # inside on_leg re-runs the leg on resume and delivers its flags
-        # again (at-least-once; a post-checkpoint crash would silently drop
-        # them, as the checkpoint does not carry flag tables). change_global
-        # is handed over host-converted (the driver already paid that d2h
-        # for its own folding) so observers reading it don't re-transfer
-        # inside the measured span.
-        if on_leg is not None:
-            on_leg(s, out.flags._replace(change_global=cg))
-        if telemetry is not None:
-            # rows counts the leg's full consumption (leg 0's batch_a seed
-            # included), so the legs sum to the summary's rows_processed.
-            telemetry.emit(
-                "leg_completed", leg=s, rows=p * L * b, detections=int(hit.size)
-            )
-            # rows_done is stream-absolute ((s+1) whole legs, resumed ones
-            # included); elapsed is this process's monotonic span — see the
-            # docstring for why the pair is safe across resumes.
-            telemetry.emit(
-                "heartbeat",
-                rows_done=(s + 1) * p * L * b,
-                elapsed_s=time.monotonic() - hb_start,
-                leg=s,
-            )
-        if metrics is not None:
-            from ..telemetry.profile import (
-                device_memory_stats,
-                record_device_memory_gauges,
-            )
-
-            record_device_memory_gauges(
-                metrics, device_memory_stats(), when="leg"
-            )
+        pending.append((s, out))
+        if len(pending) < group and s != S - 1:
+            continue  # dispatch queue stays full across the group
+        _fold_pending()
         if checkpoint_path:
             # save_checkpoint is atomic (same-dir temp + os.replace +
             # fsync — utils.checkpoint), so a crash mid-save can tear
-            # only the temp file, never the last good checkpoint.
+            # only the temp file, never the last good checkpoint. The
+            # host copy it takes happens BEFORE the next leg's dispatch
+            # donates these state buffers.
             save_checkpoint(
                 checkpoint_path,
                 out.state,

@@ -443,6 +443,13 @@ def main(argv=None) -> None:
         "distributed_drift_detection_tpu report <run.jsonl>`)",
     )
     ap.add_argument(
+        "--compile-cache-dir",
+        default="",
+        help="persistent XLA compilation cache directory "
+        "(utils.compile_cache): sweep cells repeated across invocations — "
+        "and heal re-runs — skip compilation entirely (warm-start)",
+    )
+    ap.add_argument(
         "--profile-dir",
         default="",
         help="wrap each trial's Final Time span in a jax.profiler capture "
@@ -476,6 +483,7 @@ def main(argv=None) -> None:
         per_batch=args.per_batch,
         results_csv=args.results_csv,
         data_policy=args.data_policy,
+        compile_cache_dir=args.compile_cache_dir,
     )
     run_grid(
         base,

@@ -2,6 +2,10 @@ from . import multihost
 from .mesh import (
     PARTITION_AXIS,
     MeshRunResult,
+    auto_compact_capacity,
+    compact_flag_table,
+    expand_flag_table,
+    host_flags,
     make_mesh,
     make_mesh_runner,
     partition_sharding,
@@ -15,6 +19,10 @@ __all__ = [
     "partition_sharding",
     "unpack_flags",
     "MeshRunResult",
+    "auto_compact_capacity",
+    "compact_flag_table",
+    "expand_flag_table",
+    "host_flags",
     "make_mesh",
     "make_mesh_runner",
     "shard_batches",

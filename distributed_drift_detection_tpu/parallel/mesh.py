@@ -82,19 +82,162 @@ class MeshRunResult(NamedTuple):
     # transfer regardless of size), so the collect phase fetches this single
     # array instead of five leaves. Unpack with :func:`unpack_flags`.
     packed: jax.Array
+    # Device-compacted detection table ``[capacity + 1, 7]`` i32 (None when
+    # compaction is off — RunConfig.collect='full' / validate=True). Drift
+    # is rare, so almost every slot of the packed plane is sentinel fill;
+    # this table carries only the flagged slots — columns (partition,
+    # batch, warning_local, warning_global, change_local, change_global,
+    # forced_retrain), sentinel-filled rows with partition = −1, and the
+    # TOTAL flagged-slot count embedded in the extra last row so overflow
+    # detection and the payload ride one d2h transfer. Rebuild the full
+    # host table with :func:`expand_flag_table`; a count beyond capacity
+    # means the table is partial — fall back to ``packed``
+    # (:func:`host_flags` does, loudly).
+    compact: "jax.Array | None" = None
 
 
-def finish_mesh_run(flags: FlagRows) -> MeshRunResult:
+def auto_compact_capacity(partitions: int, flag_rows: int) -> int:
+    """Default compacted-table capacity for a ``[P, NBF]`` flag plane.
+
+    ~P·NBF/8 entries (floor 64), clamped to the slot count: at 28 B/entry
+    vs the plane's 20 B/slot the table stays ~5.7× smaller than the plane
+    while overflow needs >12.5% of ALL slots flagged — far denser than any
+    planted-drift stream (headline geometry flags ~1-3% of slots). At the
+    clamp the table covers every slot, so overflow is impossible.
+    """
+    slots = max(int(partitions) * int(flag_rows), 1)
+    return min(max(64, slots // 8), slots)
+
+
+def compact_flag_table(flags: FlagRows, capacity: int) -> jax.Array:
+    """The in-jit compaction epilogue: ``FlagRows [P, NBF]`` → dense
+    ``[capacity + 1, 7]`` i32 table (see :attr:`MeshRunResult.compact`).
+
+    A slot is *flagged* when any leaf is non-sentinel (a warning, a change,
+    or a forced retrain — by ``engine.loop``'s construction the global
+    columns are derived from the locals, so the three tests cover all
+    five). ``jnp.nonzero(size=...)`` is the segment compaction: static
+    output shape, first ``min(n, capacity)`` flagged slots in row-major
+    order, −1 fill beyond them.
+    """
+    k = int(capacity)
+    p, nbf = flags.change_local.shape
+    flagged = (
+        (flags.warning_local >= 0)
+        | (flags.change_local >= 0)
+        | flags.forced_retrain
+    )
+    flat = flagged.ravel()
+    n = jnp.sum(flat, dtype=jnp.int32)  # true count — may exceed capacity
+    (pos,) = jnp.nonzero(flat, size=k, fill_value=-1)
+    ok = pos >= 0
+    safe = jnp.maximum(pos, 0)
+
+    def take(leaf):
+        return jnp.where(ok, leaf.ravel()[safe].astype(jnp.int32), -1)
+
+    entries = jnp.stack(
+        [
+            jnp.where(ok, (pos // nbf).astype(jnp.int32), -1),
+            jnp.where(ok, (pos % nbf).astype(jnp.int32), -1),
+            take(flags.warning_local),
+            take(flags.warning_global),
+            take(flags.change_local),
+            take(flags.change_global),
+            take(flags.forced_retrain),
+        ],
+        axis=1,
+    )  # [K, 7]
+    counter = jnp.concatenate([n[None], jnp.zeros(6, jnp.int32)])[None]
+    return jnp.concatenate([entries, counter], axis=0)
+
+
+def expand_flag_table(
+    table: np.ndarray, partitions: int, flag_rows: int
+) -> FlagRows | None:
+    """Host-side inverse of :func:`compact_flag_table`: scatter the table's
+    entries back into a sentinel-initialised ``[P, NBF]`` flag plane —
+    bit-identical to :func:`unpack_flags` of the full plane (tested).
+    Returns ``None`` when the embedded count exceeds the table's capacity:
+    the table is then partial and only the full plane holds the truth.
+    """
+    table = np.asarray(table)
+    capacity = table.shape[0] - 1
+    n_events = int(table[-1, 0])
+    if n_events > capacity:
+        return None
+    entries = table[:capacity]
+    entries = entries[entries[:, 0] >= 0]
+    shape = (int(partitions), int(flag_rows))
+    leaves = [np.full(shape, -1, np.int32) for _ in range(4)]
+    forced = np.zeros(shape, bool)
+    pq, bq = entries[:, 0], entries[:, 1]
+    for col, leaf in enumerate(leaves, start=2):
+        leaf[pq, bq] = entries[:, col]
+    forced[pq, bq] = entries[:, 6] != 0
+    return FlagRows(*leaves, forced)
+
+
+def host_flags(result: MeshRunResult) -> tuple[FlagRows, dict]:
+    """The collect phase's device→host step: host ``FlagRows`` plus a
+    provenance dict (``mode``, ``events``, ``overflow``).
+
+    Compacted runners ship the small table in one latency-bound transfer;
+    a table overflow (more flagged slots than capacity — a stream flagging
+    >12.5% of all slots at the auto capacity) falls back to fetching the
+    full packed plane and says so via ``RuntimeWarning`` — the contract is
+    *never truncate silently*. Full-plane runners (``collect='full'``,
+    ``validate=True``) skip straight to the plane.
+    """
+    if result.compact is not None:
+        _, p, nbf = result.packed.shape  # geometry is shape metadata: free
+        table = np.asarray(result.compact)  # ONE small d2h transfer
+        n_events = int(table[-1, 0])
+        flags = expand_flag_table(table, p, nbf)
+        if flags is not None:
+            return flags, {
+                "mode": "compact", "events": n_events, "overflow": False,
+            }
+        import warnings
+
+        warnings.warn(
+            f"compacted flag table overflowed ({n_events} flagged slots > "
+            f"capacity {table.shape[0] - 1}); falling back to the full "
+            "flag plane — raise RunConfig.collect_capacity or use "
+            "collect='full' for this stream",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return unpack_flags(np.asarray(result.packed)), {
+            "mode": "full", "events": n_events, "overflow": True,
+        }
+    return unpack_flags(np.asarray(result.packed)), {
+        "mode": "full", "events": None, "overflow": False,
+    }
+
+
+def finish_mesh_run(
+    flags: FlagRows, compact_capacity: int = 0
+) -> MeshRunResult:
     """The end-of-run merge shared by every runner: cross-partition drift
     vote (lowers to an ICI all-reduce when the partition axis is
     device-sharded — the psum merge of SURVEY §2) + the packed single-array
-    collect form."""
+    collect form. ``compact_capacity > 0`` additionally fuses the
+    segment-compaction epilogue (:func:`compact_flag_table`) so collect can
+    ship O(detections) bytes instead of the plane."""
     changed = (flags.change_global >= 0).astype(jnp.float32)  # [P, NB-1]
     vote = jnp.sum(changed, axis=0) / changed.shape[0]
     packed = jnp.stack(
         [getattr(flags, f).astype(jnp.int32) for f in FlagRows._fields]
     )
-    return MeshRunResult(flags=flags, drift_vote=vote, packed=packed)
+    compact = (
+        compact_flag_table(flags, compact_capacity)
+        if compact_capacity
+        else None
+    )
+    return MeshRunResult(
+        flags=flags, drift_vote=vote, packed=packed, compact=compact
+    )
 
 
 _BOOL_FLAGS = frozenset({"forced_retrain"})
@@ -120,6 +263,7 @@ def make_mesh_runner(
     packed: bool = False,
     detector=None,
     rotations: int = 1,
+    compact_capacity: int = 0,
 ):
     """Build ``run(batches, keys) -> MeshRunResult``, jitted over the mesh.
 
@@ -139,6 +283,10 @@ def make_mesh_runner(
     ``rotations`` is the window engine's speculation depth
     (``engine.window.make_window_span``); it requires ``window > 1``
     (rejected otherwise, matching ``ChunkedDetector``).
+    ``compact_capacity > 0`` fuses the segment-compaction epilogue into the
+    program (:func:`compact_flag_table`): ``MeshRunResult.compact`` then
+    carries the dense detection table the collect phase ships instead of
+    the packed plane (:func:`host_flags`); flags are untouched.
     """
     from ..models.base import require_shardable
 
@@ -192,7 +340,9 @@ def make_mesh_runner(
             # int32 rows + validity mask out — engines see the exact
             # IndexedBatches the host striper would have built.
             batches = expand_packed(batches)
-        return finish_mesh_run(vmapped(batches, keys))
+        return finish_mesh_run(
+            vmapped(batches, keys), compact_capacity=compact_capacity
+        )
 
     if mesh is None:
         return jax.jit(run)
@@ -214,6 +364,9 @@ def make_mesh_runner(
         flags=FlagRows(*(data_sharding,) * len(FlagRows._fields)),
         drift_vote=replicated,  # replicated after the all-reduce
         packed=NamedSharding(mesh, P(None, PARTITION_AXIS)),
+        # The compacted table is tiny and its nonzero-compaction already
+        # gathered across shards — replicate it like the vote.
+        compact=replicated if compact_capacity else None,
     )
     return jax.jit(
         run, in_shardings=(in_batches, data_sharding), out_shardings=out_sharding

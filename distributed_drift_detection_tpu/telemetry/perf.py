@@ -26,7 +26,8 @@ Artifact forms accepted, in order of preference:
   ``detect_time_s`` from the non-stalled ``phase_s`` medians.
 
 Gating semantics: only robust whole-run cells gate (throughput, Final
-Time, detect time, the soak/chunked headline rates); compile splits, phase
+Time, detect time, collect's share of the span, the soak/chunked headline
+rates); compile splits (the warm-start cold/cold-xla pair included), phase
 medians, XLA counters and quality cells print informationally. A pair
 where either artifact is ``contended`` (≥ half its repetitions stalled —
 bench.py's own suspicion marker) reports its regressions as *suspect* and
@@ -59,8 +60,19 @@ CELLS = (
     ("detect_time_s", _DOWN, True, "s"),
     ("compile_first_call_s", _DOWN, False, "s"),
     ("compile_overhead_s", _DOWN, False, "s"),
+    # AOT warm-start split (cold_vs_warm_compile_s, r06+): cold_s is the
+    # prepare-phase lower().compile() span, cold_xla_s its backend-compile
+    # half (≈0 against a populated persistent cache). Informational —
+    # cache state is invocation provenance, not a code property.
+    ("compile_cold_s", _DOWN, False, "s"),
+    ("compile_cold_xla_s", _DOWN, False, "s"),
     ("phase_upload_s", _DOWN, False, "s"),
     ("phase_collect_s", _DOWN, False, "s"),
+    # Collect's share of the Final Time span (r06+): GATED — the compacted
+    # collect's whole point is keeping this small, and a regression here
+    # is a code property (the absolute phase medians above stay
+    # informational because they move with the tunnel).
+    ("collect_share", _DOWN, True, ""),
     ("soak_value", _UP, True, "rows/s"),
     ("soak_xl_value", _UP, True, "rows/s"),
     ("chunked_value", _UP, True, "rows/s"),
@@ -182,6 +194,7 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
             cells[f"phase_{name}_s"] = float(statistics.median(phase_s[name]))
 
     for k in (
+        "collect_share",
         "soak_value",
         "soak_xl_value",
         "chunked_value",
@@ -191,6 +204,13 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
     ):
         if bench.get(k) is not None:
             cells[k] = float(bench[k])
+    cvw = bench.get("cold_vs_warm_compile_s") or {}
+    for src, dst in (
+        ("cold_s", "compile_cold_s"),
+        ("cold_xla_s", "compile_cold_xla_s"),
+    ):
+        if cvw.get(src) is not None:
+            cells[dst] = float(cvw[src])
     xla = bench.get("xla") or {}
     for k in ("flops", "bytes_accessed", "temp_bytes"):
         if xla.get(k) is not None:

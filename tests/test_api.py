@@ -118,3 +118,40 @@ def test_auto_window_resolves_from_stream_geometry(tmp_path):
     assert res.config.window == 8
     assert res.config.window_rotations == 4
     assert res.metrics.num_detections > 0
+
+
+def test_prepare_aot_warm_start_and_persistent_cache(tmp_path):
+    """ISSUE 6 tentpole c: prepare AOT-compiles the runner (compile paid in
+    the prepare phase — exec_fn set, aot split recorded) and the
+    compile_cache_dir knob populates a persistent cache directory; a
+    repeat prepare at the same geometry is served by the in-process AOT
+    cache (aot_seconds == 0)."""
+    from distributed_drift_detection_tpu.api import _AOT_CACHE, prepare
+
+    cache = str(tmp_path / "cc")
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=3",
+        mult_data=2,
+        partitions=2,
+        per_batch=50,
+        model="centroid",
+        seed=3,
+        compile_cache_dir=cache,
+        results_csv="",
+    )
+    _AOT_CACHE.clear()
+    prep = prepare(cfg)
+    info = prep.compile_info
+    assert prep.exec_fn is not None
+    assert info["aot_cached"] is False and info["aot_seconds"] > 0
+    assert info["aot_compile_seconds"] > 0  # the cache-servable half
+    assert os.path.isdir(cache) and os.listdir(cache)  # cache populated
+
+    again = prepare(cfg)
+    assert again.compile_info["aot_cached"] is True
+    assert again.compile_info["aot_seconds"] == 0.0
+    assert again.exec_fn is prep.exec_fn  # the same compiled executable
+
+    # the executable the run dispatches is the AOT one — end-to-end check
+    res = run(cfg)
+    assert res.metrics.num_detections >= 0

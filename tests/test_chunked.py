@@ -311,3 +311,97 @@ def test_bf16_transport_plane_runs_and_detects():
     # Default f32 plane: identical pipeline, full precision.
     f32 = flags_for(np.float32)
     assert int((np.asarray(f32.change_global) >= 0).sum()) >= 9
+
+
+# ---------------------------------------------------------------------------
+# Donated async chunk pipeline (ISSUE 6 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+def _flags_equal(a, b):
+    for name, got, want in zip(a._fields, a, b):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=name
+        )
+
+
+def test_donation_and_deferred_groups_match_default():
+    """Flags are bit-identical across the pipeline variants: donation on
+    (default) vs off, and host collection deferred to chunk-group
+    boundaries (collect_every) vs the final concat."""
+    stream = make_stream()
+    p, b, cb = 4, 40, 3
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = make_majority(spec)
+    chunks = list(chunk_stream_arrays(stream.X, stream.y, p, b, cb))
+
+    def run_with(**kw):
+        run_kw = {k: kw.pop(k) for k in ("collect_every",) if k in kw}
+        det = ChunkedDetector(model, REF, partitions=p, seed=0, **kw)
+        return det.run(iter(chunks), **run_kw)
+
+    ref = run_with(donate=False)
+    assert int((np.asarray(ref.change_global) >= 0).sum()) > 0
+    _flags_equal(run_with(), ref)  # donation on (the default)
+    _flags_equal(run_with(collect_every=2), ref)
+    _flags_equal(run_with(collect_every=1), ref)
+    # window engine through the same donated pipeline
+    ref_w = ChunkedDetector(
+        model, REF, partitions=p, seed=0, window=4, donate=False
+    ).run(iter(chunks))
+    got_w = ChunkedDetector(
+        model, REF, partitions=p, seed=0, window=4
+    ).run(iter(chunks), collect_every=2)
+    _flags_equal(got_w, ref_w)
+
+
+def test_place_feed_pipeline_matches_feed():
+    """Pre-placing chunks (the double-buffer surface run() drives) and
+    feeding placed chunks is identical to feeding host chunks."""
+    stream = make_stream()
+    p, b, cb = 4, 40, 3
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = make_majority(spec)
+    chunks = list(chunk_stream_arrays(stream.X, stream.y, p, b, cb))
+
+    plain = ChunkedDetector(model, REF, partitions=p, seed=0)
+    want = [plain.feed(c) for c in chunks]
+
+    det = ChunkedDetector(model, REF, partitions=p, seed=0)
+    got = [det.feed(det.place(c)) for c in chunks]
+    for g, w in zip(got, want):
+        _flags_equal(g, w)
+
+
+def test_emit_chunk_event_keeps_flags_deferred():
+    """The progress event transfers a scalar count, not the flag table:
+    the returned flags stay device-resident jax arrays, and the event
+    payload is unchanged."""
+    stream = make_stream()
+    p, b, cb = 4, 40, 3
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = make_majority(spec)
+    chunks = list(chunk_stream_arrays(stream.X, stream.y, p, b, cb))
+
+    class FakeLog:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, type_, **payload):
+            self.events.append({"type": type_, **payload})
+
+    log = FakeLog()
+    det = ChunkedDetector(model, REF, partitions=p, seed=0)
+    total = 0
+    for i, c in enumerate(chunks):
+        flags = det.feed(c)
+        flags, n = det.emit_chunk_event(log, i, flags)
+        assert isinstance(flags.change_global, jax.Array)  # still deferred
+        total += n
+    want = sum(
+        e["detections"] for e in log.events if e["type"] == "chunk_completed"
+    )
+    assert total == want
+    # counts match a full host collection of the same stream
+    ref = ChunkedDetector(model, REF, partitions=p, seed=0).run(iter(chunks))
+    assert total == int((np.asarray(ref.change_global) >= 0).sum())

@@ -1,0 +1,289 @@
+"""Device-side flag compaction (ISSUE 6 tentpole a).
+
+The collect phase's contract: flags reconstructed host-side from the
+device-compacted detection table are **bit-identical** to the full-plane
+path — on both engines (the sequential batch-per-step scan, window=1, and
+the speculative window engine, window>1), across seeds, on streams with
+zero detections, and under table overflow (which must fall back to the
+full plane loudly, never truncate silently).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.api import prepare, run
+from distributed_drift_detection_tpu.config import RunConfig, replace
+from distributed_drift_detection_tpu.engine.loop import FlagRows
+from distributed_drift_detection_tpu.parallel.mesh import (
+    auto_compact_capacity,
+    compact_flag_table,
+    expand_flag_table,
+    host_flags,
+    unpack_flags,
+)
+
+
+def _random_flags(rng, p, nbf, flag_fraction):
+    """A synthetic FlagRows plane with `flag_fraction` of slots flagged in
+    every combination the engines can produce (warning-only, change-only,
+    both, forced-retrain-only, padding-row globals = −1)."""
+    shape = (p, nbf)
+    wl = np.full(shape, -1, np.int32)
+    wg = np.full(shape, -1, np.int32)
+    cl = np.full(shape, -1, np.int32)
+    cg = np.full(shape, -1, np.int32)
+    fr = np.zeros(shape, bool)
+    flagged = rng.random(shape) < flag_fraction
+    kind = rng.integers(0, 4, shape)  # 0=warn 1=change 2=both 3=forced
+    warn = flagged & ((kind == 0) | (kind == 2))
+    change = flagged & ((kind == 1) | (kind == 2))
+    forced = flagged & (kind == 3)
+    wl[warn] = rng.integers(0, 100, int(warn.sum()))
+    # a detected row that was padding carries global −1 with local >= 0
+    wg[warn] = np.where(
+        rng.random(int(warn.sum())) < 0.9,
+        rng.integers(0, 10_000, int(warn.sum())),
+        -1,
+    )
+    cl[change] = rng.integers(0, 100, int(change.sum()))
+    cg[change] = np.where(
+        rng.random(int(change.sum())) < 0.9,
+        rng.integers(0, 10_000, int(change.sum())),
+        -1,
+    )
+    fr[forced] = True
+    return FlagRows(wl, wg, cl, cg, fr)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("flag_fraction", [0.0, 0.05, 1.0])
+def test_table_roundtrip_property(seed, flag_fraction):
+    """compact (in-jit) → expand (host) is the identity on any flag plane
+    that fits the capacity — including all-sentinel and fully-flagged."""
+    rng = np.random.default_rng(seed)
+    p, nbf = 5, 37
+    flags = _random_flags(rng, p, nbf, flag_fraction)
+    capacity = p * nbf  # covers every slot: overflow impossible
+    table = np.asarray(
+        jax.jit(compact_flag_table, static_argnums=1)(
+            jax.tree.map(jnp.asarray, flags), capacity
+        )
+    )
+    got = expand_flag_table(table, p, nbf)
+    assert got is not None
+    for name in FlagRows._fields:
+        np.testing.assert_array_equal(
+            getattr(got, name), getattr(flags, name), err_msg=name
+        )
+    # the embedded counter is the true flagged-slot count
+    want_n = int(
+        (
+            (flags.warning_local >= 0)
+            | (flags.change_local >= 0)
+            | flags.forced_retrain
+        ).sum()
+    )
+    assert int(table[-1, 0]) == want_n
+
+
+def test_overflow_expand_refuses():
+    """A table whose embedded count exceeds capacity is partial: expand
+    returns None (the caller must fall back), never a truncated plane."""
+    rng = np.random.default_rng(7)
+    flags = _random_flags(rng, 4, 32, 0.5)
+    n = int(
+        (
+            (flags.warning_local >= 0)
+            | (flags.change_local >= 0)
+            | flags.forced_retrain
+        ).sum()
+    )
+    assert n > 3
+    table = np.asarray(
+        compact_flag_table(jax.tree.map(jnp.asarray, flags), 3)
+    )
+    assert int(table[-1, 0]) == n  # the true count survives the overflow
+    assert expand_flag_table(table, 4, 32) is None
+
+
+def test_auto_capacity_bounds():
+    assert auto_compact_capacity(1, 10) == 10  # clamped to the slot count
+    assert auto_compact_capacity(16, 1280) == 16 * 1280 // 8
+    assert auto_compact_capacity(4, 100) == 64  # the floor
+
+
+def _flags_equal(a: FlagRows, b: FlagRows):
+    for name in FlagRows._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=name,
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("window", [1, 8])
+def test_api_compact_matches_full_plane(seed, window):
+    """The acceptance pin: compacted-collect drift flags reconstructed
+    host-side are bit-identical to the full-plane path, ≥3 seeds × both
+    engines (window=1 sequential scan, window>1 speculative window)."""
+    cfg = RunConfig(
+        dataset=f"synth:rialto,seed={seed}",
+        mult_data=2,
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        window=window,
+        window_rotations=1,
+        seed=seed,
+        results_csv="",
+    )
+    full = run(replace(cfg, collect="full"))
+    comp = run(cfg)
+    _flags_equal(full.flags, comp.flags)
+    np.testing.assert_array_equal(full.drift_vote, comp.drift_vote)
+    assert full.metrics.num_detections == comp.metrics.num_detections
+    # the streams plant drift — a vacuous zero-detection pass would prove
+    # nothing here (the zero case has its own test below)
+    assert comp.metrics.num_detections > 0
+
+
+def test_api_zero_detection_stream_compact():
+    """Zero detections: the table is all sentinel fill with counter 0 and
+    the reconstruction equals the (all-sentinel) full plane."""
+    from distributed_drift_detection_tpu.io.stream import synthesize_stream
+
+    # One concept, zero planted boundaries: a majority model on a
+    # constant-label stream never errs, so no detector ever arms.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    y = np.zeros(800, np.int64)
+    stream = synthesize_stream(X, y, mult_data=1.0, standardize=False)
+    cfg = RunConfig(
+        dataset="unused",
+        partitions=4,
+        per_batch=50,
+        model="majority",
+        window=1,
+        window_rotations=1,
+        results_csv="",
+    )
+    full = run(replace(cfg, collect="full"), stream=stream)
+    comp = run(cfg, stream=stream)
+    assert comp.metrics.num_detections == 0
+    assert not comp.flags.forced_retrain.any()
+    _flags_equal(full.flags, comp.flags)
+
+
+def test_api_overflow_falls_back_loudly():
+    """A synthetic stream overflowing the compaction capacity must fall
+    back to the full plane with a RuntimeWarning — flags still exact."""
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0",
+        mult_data=2,
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        results_csv="",
+    )
+    full = run(replace(cfg, collect="full"))
+    assert full.metrics.num_detections > 1  # capacity=1 must overflow
+    with pytest.warns(RuntimeWarning, match="overflowed"):
+        comp = run(replace(cfg, collect_capacity=1))
+    _flags_equal(full.flags, comp.flags)
+    np.testing.assert_array_equal(full.drift_vote, comp.drift_vote)
+
+
+def test_validate_forces_full_plane():
+    """validate=True is an escape hatch: the runner must not compact (the
+    audit wants the device-produced plane), and the run still validates."""
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0",
+        mult_data=2,
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        validate=True,
+        results_csv="",
+    )
+    prep = prepare(cfg)
+    out = (prep.exec_fn or prep.runner)(
+        jax.tree.map(jnp.asarray, prep.batches), prep.keys
+    )
+    assert out.compact is None
+    res = run(cfg)  # validate_flag_rows runs; must not raise
+    assert res.metrics.num_detections > 0
+
+
+def test_unknown_collect_mode_rejected():
+    with pytest.raises(ValueError, match="collect mode"):
+        prepare(
+            RunConfig(
+                dataset="synth:rialto,seed=0", collect="zip", results_csv=""
+            )
+        )
+
+
+def test_host_flags_matches_unpack_on_full_runner():
+    """host_flags on a full-plane result is exactly unpack_flags."""
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0",
+        mult_data=2,
+        partitions=2,
+        per_batch=50,
+        model="centroid",
+        collect="full",
+        results_csv="",
+    )
+    prep = prepare(cfg)
+    out = (prep.exec_fn or prep.runner)(
+        jax.tree.map(jnp.asarray, prep.batches), prep.keys
+    )
+    flags, info = host_flags(out)
+    assert info["mode"] == "full" and not info["overflow"]
+    _flags_equal(flags, unpack_flags(np.asarray(out.packed)))
+
+
+def test_negative_collect_capacity_rejected():
+    with pytest.raises(ValueError, match="collect_capacity"):
+        prepare(
+            RunConfig(
+                dataset="synth:rialto,seed=0", collect_capacity=-1,
+                results_csv="",
+            )
+        )
+
+
+def test_run_completed_carries_collect_provenance(tmp_path):
+    """The run log records which collect transport actually shipped —
+    including the overflow fallback — so a fleet operator can see a
+    stream that overflows the compaction capacity every run."""
+    from distributed_drift_detection_tpu.telemetry.events import read_events
+
+    cfg = RunConfig(
+        dataset="synth:rialto,seed=0",
+        mult_data=2,
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        telemetry_dir=str(tmp_path),
+        results_csv="",
+    )
+    res = run(cfg)
+    (done,) = [
+        e for e in read_events(res.telemetry_path)
+        if e["type"] == "run_completed"
+    ]
+    assert done["collect_mode"] == "compact"
+    assert done["collect_overflow"] is False
+    assert done["collect_events"] == res.metrics.num_detections
+
+    with pytest.warns(RuntimeWarning, match="overflowed"):
+        res2 = run(replace(cfg, collect_capacity=1))
+    (done2,) = [
+        e for e in read_events(res2.telemetry_path)
+        if e["type"] == "run_completed"
+    ]
+    assert done2["collect_mode"] == "full" and done2["collect_overflow"] is True

@@ -238,3 +238,50 @@ def test_cli_over_committed_bench_history(capsys):
     )
     perf_main(history + ["--informational"])
     assert "perf diff over 5 artifact(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# r06 cells: collect_share (gated) + the warm-start compile pair
+# ---------------------------------------------------------------------------
+
+
+def test_collect_share_cell_gates_regressions():
+    """collect_share is a GATED cell: a share creeping back up beyond
+    tolerance fails the diff (the compacted collect's whole point), while
+    an improvement passes."""
+    old = _bench(collect_share=0.20)
+    new = _bench(collect_share=0.08)
+    _, regs = diff_benches(
+        [("old", old, []), ("new", new, [])], tolerance=0.10
+    )
+    assert not [r for r in regs if r.cell == "collect_share"]
+
+    worse = _bench(collect_share=0.30)
+    _, regs = diff_benches(
+        [("new", new, []), ("worse", worse, [])], tolerance=0.10
+    )
+    gating = [r for r in regs if r.cell == "collect_share" and not r.suspect]
+    assert gating and gating[0].pct > 0
+
+
+def test_cold_vs_warm_compile_cells_informational():
+    """The warm-start pair renders as cells but never gates: cache state
+    is invocation provenance, not a code property."""
+    cold = _bench(
+        cold_vs_warm_compile_s={
+            "cold_s": 2.1, "cold_xla_s": 1.3, "warm_s": 0.001,
+        }
+    )
+    warm = _bench(
+        cold_vs_warm_compile_s={
+            "cold_s": 1.1, "cold_xla_s": 0.3, "warm_s": 0.001,
+        }
+    )
+    cells, _ = bench_cells(cold)
+    assert cells["compile_cold_s"] == 2.1
+    assert cells["compile_cold_xla_s"] == 1.3
+    # even a 10x adverse move in the pair must not gate
+    _, regs = diff_benches(
+        [("warm", warm, []), ("cold", cold, [])], tolerance=0.10
+    )
+    assert not [r for r in regs if r.cell.startswith("compile_cold")]

@@ -740,3 +740,90 @@ def test_main_usage_mentions_heal():
     from distributed_drift_detection_tpu.__main__ import _USAGE
 
     assert "heal SPEC" in _USAGE
+
+
+def test_soak_chain_kill_resume_under_donation_and_deferred_sync(tmp_path):
+    """ISSUE 6 parity satellite: the PR-4 kill-and-resume chain contract
+    re-proven under the donated-leg + deferred-sync pipeline — state
+    donation (the r06 default) with host folding/checkpoints deferred to
+    2-leg group boundaries still restores bit-identical flags after a
+    mid-chain kill."""
+    kw = dict(
+        partitions=2, per_batch=50, total_rows=20_000, drift_every=500,
+        max_leg_rows=5_000, collect_every=2,
+    )
+    model = build_model("centroid", ModelSpec(8, 8))
+
+    def collect(into):
+        def on_leg(s, flags):
+            into[s] = jax.tree.map(np.asarray, flags)
+        return on_leg
+
+    # The pre-donation/per-leg-sync driver is the reference semantics.
+    clean: dict = {}
+    summary_clean = run_soak_chained(
+        model, partitions=2, per_batch=50, total_rows=20_000,
+        drift_every=500, max_leg_rows=5_000, donate=False,
+        on_leg=collect(clean),
+    )
+    assert summary_clean.legs == 4
+
+    ckpt = str(tmp_path / "chain.npz")
+    crashed: dict = {}
+    # Kill at leg 2: the group-of-2 boundary after legs {0,1} has folded
+    # and checkpointed, so the resume restarts exactly at the boundary.
+    faults.arm("soak.leg", at=3)
+    with pytest.raises(faults.InjectedFault):
+        run_soak_chained(
+            model, **kw, checkpoint_path=ckpt, on_leg=collect(crashed)
+        )
+    faults.disarm_all()
+    assert sorted(crashed) == [0, 1] and os.path.exists(ckpt)
+
+    resumed: dict = {}
+    summary = run_soak_chained(
+        model, **kw, checkpoint_path=ckpt, on_leg=collect(resumed)
+    )
+    assert sorted(resumed) == [2, 3]  # only the unfinished group re-ran
+
+    merged = {**crashed, **resumed}
+    assert sorted(merged) == sorted(clean)
+    for s in clean:
+        for got, want in zip(
+            jax.tree.leaves(merged[s]), jax.tree.leaves(clean[s])
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert summary.detections == summary_clean.detections
+    np.testing.assert_array_equal(
+        np.sort(summary.delays), np.sort(summary_clean.delays)
+    )
+    assert not os.path.exists(ckpt)
+
+
+def test_soak_chain_mid_group_kill_replays_group(tmp_path):
+    """A kill INSIDE a deferred-sync group resumes from the last group
+    boundary: the group's legs re-run and re-deliver (at-least-once with
+    the group as the unit), and the final stats match a clean run."""
+    kw = dict(
+        partitions=2, per_batch=50, total_rows=20_000, drift_every=500,
+        max_leg_rows=5_000,
+    )
+    model = build_model("centroid", ModelSpec(8, 8))
+    clean = run_soak_chained(model, **kw, donate=False)
+
+    ckpt = str(tmp_path / "chain.npz")
+    faults.arm("soak.leg", at=2)  # kill at leg 1 — mid-group for groups of 2
+    with pytest.raises(faults.InjectedFault):
+        run_soak_chained(
+            model, **kw, checkpoint_path=ckpt, collect_every=2
+        )
+    faults.disarm_all()
+    # no boundary reached → no checkpoint: the resume replays from leg 0
+    assert not os.path.exists(ckpt)
+    summary = run_soak_chained(
+        model, **kw, checkpoint_path=ckpt, collect_every=2
+    )
+    assert summary.detections == clean.detections
+    np.testing.assert_array_equal(
+        np.sort(summary.delays), np.sort(clean.delays)
+    )

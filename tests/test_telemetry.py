@@ -626,12 +626,14 @@ def test_profile_extraction_outside_timed_span(tmp_path, monkeypatch):
 
         return wrapped
 
-    # shard_batches/unpack_flags bracket the timed span (upload + collect).
+    # shard_batches/host_flags bracket the timed span (upload + collect —
+    # host_flags is the collect phase's d2h step since the compacted-table
+    # transport replaced the direct unpack_flags call, r06).
     monkeypatch.setattr(
         api_mod, "shard_batches", tap("span_upload", api_mod.shard_batches)
     )
     monkeypatch.setattr(
-        api_mod, "unpack_flags", tap("span_collect", api_mod.unpack_flags)
+        api_mod, "host_flags", tap("span_collect", api_mod.host_flags)
     )
     monkeypatch.setattr(
         profile_mod,
