@@ -65,6 +65,13 @@ populated persistent cache), ``warm_s`` the same-process re-lower floor.
 (default: ``.jax_cache`` next to this script); the CI warm-start gate runs
 ``--smoke`` twice against a shared directory and asserts the second run's
 ``cold_s`` collapses.
+
+Round-7 addition: ``--serve [ROWS [RATE]]`` runs the online-serving SLO
+bench — an in-process ``serve`` daemon on a loopback socket, warmed
+(AOT prepare + one warm-up replay), then driven by the loadgen at RATE
+rows/s — and emits ``serve_rows_per_sec`` with ``serve_p50_ms`` /
+``serve_p99_ms`` row→verdict latency (tracked informationally by the
+``perf`` CLI).
 """
 
 import json
@@ -704,6 +711,102 @@ def _headline_core(prep, reps: int = 15, stall_factor: float = 1.5) -> dict:
     }
 
 
+def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
+    """``--serve``: the online-serving SLO bench — an in-process daemon on
+    a loopback socket, driven by the loadgen at ``rate`` rows/s (0 = as
+    fast as the socket takes them).
+
+    The daemon is **warm** before the measured replay: AOT prepare paid at
+    start (persistent compile cache shared with the other bench modes),
+    plus one warm-up replay through the full ingress→admission→detect→
+    verdict path — so the reported p50/p99 row→verdict latency and
+    sustained rows/s describe steady-state serving, not cold-start. The
+    measured replay ends with a drain (STOP), and the daemon's registry
+    record must read ``completed`` for the numbers to be trusted.
+    """
+    import threading
+
+    from distributed_drift_detection_tpu.config import RunConfig, ServeParams
+    from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import (
+        format_lines,
+        run_loadgen,
+    )
+
+    cfg = RunConfig(
+        partitions=8,
+        per_batch=100,
+        model="centroid",
+        window=1,
+        data_policy="quarantine",
+        results_csv="",
+        compile_cache_dir=_CLI["compile_cache_dir"]
+        or os.path.join(_BENCH_DIR, ".jax_cache"),
+    )
+    X, y = rialto_like_xy(seed=0, rows_per_class=max(rows // 10, 100))
+    params = ServeParams(
+        num_features=int(X.shape[1]),
+        num_classes=10,
+        port=0,
+        chunk_batches=4,
+        linger_s=0.1,
+    )
+    runner = ServeRunner(cfg, params)
+    banner = runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    lines = format_lines(X[:rows], y[:rows])
+    # Warm-up replay: one full pipeline's worth of chunks through the wire
+    # path, so the measured replay sees a steady-state daemon.
+    warm_n = min(len(lines) // 2, 2 * params.chunk_batches * cfg.partitions * cfg.per_batch)
+    run_loadgen(
+        banner["host"],
+        banner["port"],
+        lines[:warm_n],
+        verdicts=banner["verdicts"],
+        timeout=300,
+    )
+    rep = run_loadgen(
+        banner["host"],
+        banner["port"],
+        lines,
+        rate=rate,
+        verdicts=banner["verdicts"],
+        timeout=600,
+        stop=True,
+    )
+    thread.join(timeout=120)
+    return {
+        "serve_rows": rep["rows_sent"],
+        "serve_rows_per_sec": rep["achieved_rows_per_sec"],
+        "serve_target_rows_per_sec": rate or None,
+        "serve_p50_ms": rep["p50_ms"],
+        "serve_p99_ms": rep["p99_ms"],
+        "serve_mean_ms": rep["mean_ms"],
+        "serve_detections": rep["detections"],
+        "serve_verdicts": rep["verdicts"],
+        "serve_timeout": rep["timeout"],
+        "serve_drained": not thread.is_alive(),
+    }
+
+
+def serve_bench(rows: int, rate: float) -> None:
+    import jax
+
+    _enable_compile_cache(jax)
+    print(
+        json.dumps(
+            {
+                "metric": "serve_row_to_verdict",
+                "unit": "ms",
+                **_serve_stats(rows, rate),
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
+
 def smoke() -> None:
     """--smoke mode: the CI-scale artifact-contract check — the headline
     measurement pipeline on the self-contained synthetic rialto stand-in
@@ -893,6 +996,7 @@ if __name__ == "__main__":
     is_soak = len(sys.argv) > 1 and sys.argv[1] == "--soak"
     is_chunked = len(sys.argv) > 1 and sys.argv[1] == "--chunked"
     is_smoke = len(sys.argv) > 1 and sys.argv[1] == "--smoke"
+    is_serve = len(sys.argv) > 1 and sys.argv[1] == "--serve"
     try:
         if is_soak:
             soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
@@ -900,6 +1004,11 @@ if __name__ == "__main__":
             chunked()
         elif is_smoke:
             smoke()
+        elif is_serve:
+            serve_bench(
+                int(float(sys.argv[2])) if len(sys.argv) > 2 else 20_000,
+                float(sys.argv[3]) if len(sys.argv) > 3 else 0.0,
+            )
         else:
             main()
     except Exception as e:  # still emit ONE parseable JSON line on failure
@@ -911,6 +1020,8 @@ if __name__ == "__main__":
             metric = "soak_rows_per_sec_chip"
         elif is_chunked:
             metric = "chunked_rows_per_sec_chip"
+        elif is_serve:
+            metric = "serve_row_to_verdict"
         print(
             json.dumps(
                 {

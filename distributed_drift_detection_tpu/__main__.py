@@ -27,6 +27,21 @@ repeated invocations skip XLA compilation — ``utils.compile_cache``), and
 ``--collect {compact,full}`` pins the collect-phase transport
 (device-compacted detection table vs full flag plane; flags identical).
 
+Two serving subcommands run the online daemon and its load generator
+(``serve`` subsystem, docs/SERVING.md):
+
+    python -m distributed_drift_detection_tpu serve --features F --classes C [...]
+    python -m distributed_drift_detection_tpu loadgen SOURCE --port P [...]
+
+``serve`` is the always-on drift-serving daemon: a socket line-protocol
+ingress sanitized at admission (strict|quarantine|repair), microbatched
+into fixed-geometry chunks, detected by the AOT-warmed chunked engine,
+verdicts + heartbeats published through the telemetry registry so
+``watch``/``report`` work unchanged on the live service; SIGTERM drains
+and checkpoints. ``loadgen`` replays an ``io/synth`` spec or CSV at a
+target rows/s (optionally with seeded dirty rows) and reports achieved
+rate + p50/p99 row→verdict latency as JSON.
+
 Six further subcommands work offline (no accelerator — ``doctor`` reads
 the data, the rest just the artifacts; ``heal --execute`` is the one that
 runs experiments):
@@ -61,6 +76,8 @@ _USAGE = (
     "[--data-policy strict|quarantine|repair] "
     "[--compile-cache-dir DIR] [--collect compact|full] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
+    "       python -m distributed_drift_detection_tpu serve --features F --classes C [...]\n"
+    "       python -m distributed_drift_detection_tpu loadgen SOURCE --port P [...]\n"
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
@@ -121,6 +138,18 @@ def main(argv: list[str]) -> None:
         from .io.sanitize import main as doctor_main
 
         doctor_main(argv[1:])
+        return
+    if argv and argv[0] == "serve":
+        # The always-on serving daemon (serve subsystem, docs/SERVING.md).
+        from .serve.runner import main as serve_main
+
+        serve_main(argv[1:])
+        return
+    if argv and argv[0] == "loadgen":
+        # Stream replay + row→verdict latency SLO probe for `serve`.
+        from .serve.loadgen import main as loadgen_main
+
+        loadgen_main(argv[1:])
         return
 
     argv = list(argv)

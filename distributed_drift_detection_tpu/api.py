@@ -413,6 +413,96 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     )
 
 
+def prepare_chunked(
+    cfg: RunConfig,
+    num_features: int,
+    num_classes: int,
+    *,
+    chunk_batches: int = 4,
+    mesh=None,
+    validate: bool = False,
+):
+    """Streaming twin of :func:`prepare`: a RunConfig → an AOT-warmed
+    :class:`~..engine.chunked.ChunkedDetector` ready to serve traffic.
+
+    The batch :func:`prepare` loads a stream, infers its geometry, and
+    AOT-compiles the one-shot mesh runner; a long-lived service has no
+    stream yet — its row geometry (``num_features``/``num_classes``) is
+    configuration — and runs the *chunked* engine, so this resolves the
+    same config policies (detector construction, RETRAIN_AUTO via the
+    model-spec flag, persistent compile cache) against the chunk program
+    instead. The AOT warm-start (``ChunkedDetector.prepare`` against a
+    zero-row chunk of the serving geometry) compiles both chunk shapes the
+    serve loop will see *before* the first row arrives; with
+    ``cfg.compile_cache_dir`` the backend compile is served from the
+    persistent cache across daemon restarts. ``cfg.window`` must be
+    explicit (the 0 = auto policy needs planted-drift geometry a live
+    stream does not declare). Returns ``(detector, compile_info)``.
+    """
+    import numpy as _np
+
+    from .engine.chunked import ChunkedDetector
+    from .io.stream import stripe_chunk
+    from .ops.detectors import make_detector
+
+    if cfg.window == 0:
+        raise ValueError(
+            "window=0 (auto) needs stream geometry a serving daemon does "
+            "not have; pass an explicit width (config.auto_window can "
+            "compute one from a known drift spacing)"
+        )
+    if num_features <= 0 or num_classes <= 0:
+        raise ValueError(
+            f"serving geometry must be explicit: num_features="
+            f"{num_features}, num_classes={num_classes} (both must be > 0)"
+        )
+    if chunk_batches <= 0:
+        raise ValueError(f"chunk_batches must be > 0, got {chunk_batches}")
+    if cfg.compile_cache_dir:
+        from .utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(cfg.compile_cache_dir)
+    t0 = time.perf_counter()
+    spec = ModelSpec(num_features, num_classes)
+    model = build_model(cfg.model, spec, cfg)
+    det = ChunkedDetector(
+        model,
+        cfg.ddm,
+        partitions=cfg.partitions,
+        shuffle=False,  # serve stripes host-side (config.host_shuffle_seed)
+        retrain_error_threshold=cfg.retrain_error_threshold,
+        seed=cfg.seed,
+        window=cfg.window,
+        mesh=mesh,
+        detector=make_detector(
+            cfg.detector,
+            ddm=cfg.ddm,
+            ph=cfg.ph,
+            eddm=cfg.eddm,
+            hddm=cfg.hddm,
+            hddm_w=cfg.hddm_w,
+            adwin=cfg.adwin,
+            kswin=cfg.kswin,
+            stepd=cfg.stepd,
+        ),
+        rotations=cfg.window_rotations or 1,
+        validate=validate,
+    )
+    build_seconds = time.perf_counter() - t0
+    example = stripe_chunk(
+        _np.zeros((0, num_features), _np.float32),
+        _np.zeros((0,), _np.int32),
+        0,
+        cfg.partitions,
+        cfg.per_batch,
+        chunk_batches,
+    )
+    info = {"cached": False, "build_seconds": build_seconds}
+    if not model.host_callback:
+        info.update(det.prepare(example))
+    return det, info
+
+
 class RunResult(NamedTuple):
     flags: FlagRows  # numpy leaves [P, NB-1]
     drift_vote: np.ndarray  # [NB-1]

@@ -258,6 +258,47 @@ DATA_POLICIES = ("strict", "quarantine", "repair")
 COLLECT_MODES = ("compact", "full")
 
 
+class ServeParams(NamedTuple):
+    """Deployment knobs of the online serving daemon (``serve`` subsystem).
+
+    Everything *model/detector/stream-shaped* stays on :class:`RunConfig`
+    (the serve loop runs the same engines); this tuple holds only what a
+    long-lived service adds on top. jax-free, like the rest of this module,
+    so the ``serve``/``loadgen`` CLIs can validate argv without a backend.
+
+    ``num_features``/``num_classes`` are **required** (> 0): a daemon must
+    know its row geometry before the first row arrives — chunk shapes are
+    static (the no-recompile contract), and the model spec is built from
+    them, not inferred from data the way the batch loader does.
+    """
+
+    num_features: int = 0  # required: feature count of every ingress row
+    num_classes: int = 0  # required: label domain 0..C-1
+    host: str = "127.0.0.1"
+    # TCP ingress port (0 = OS-assigned, printed in the startup banner);
+    # None = no socket at all — the in-process embedding used by tests and
+    # bench --serve drives the admission controller directly.
+    port: "int | None" = 0
+    # Microbatch geometry: one flushed chunk is [partitions, chunk_batches,
+    # per_batch] rows (partitions/per_batch from the RunConfig).
+    chunk_batches: int = 4
+    # Max-linger deadline: a partial microbatch older than this is flushed
+    # short (padded through the validity plane — static shapes, no
+    # recompile) rather than waiting for the grid to fill.
+    linger_s: float = 0.25
+    # Serving-loop poll granularity (batcher waits, stop checks).
+    poll_s: float = 0.05
+    # Checkpoint path ('' = stateless serving): the detector carry +
+    # stream-position meta, written atomically after every
+    # ``checkpoint_every``-th published microbatch and at drain — the
+    # kill-and-resume contract.
+    checkpoint: str = ""
+    checkpoint_every: int = 1
+    # Idle liveness: emit a heartbeat event at least this often even with
+    # no traffic, so `watch --stall-after` can tell "idle" from "dead".
+    heartbeat_s: float = 10.0
+
+
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
     """Full configuration of one drift-detection run."""

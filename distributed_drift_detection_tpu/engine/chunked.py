@@ -67,6 +67,17 @@ del _warnings
 DEFAULT_TELEMETRY_COLLECT_EVERY = 8
 
 
+def _chunk_sig(chunk: Batches) -> tuple:
+    """Shape/dtype signature of a chunk — the AOT-executable lookup key
+    (:meth:`ChunkedDetector.prepare`). The carry's avals are fixed for a
+    detector's lifetime, so the chunk signature alone identifies the
+    compiled program."""
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree.leaves(chunk)
+    )
+
+
 class ChunkedDetector:
     """Stateful driver around the jitted per-chunk scan.
 
@@ -202,6 +213,16 @@ class ChunkedDetector:
         # ranges, warning/change ordering — so index-plane corruption is
         # caught on the chunked path too, not just api.run's.
         self.validate = validate
+        # AOT warm-start surface (:meth:`prepare`): chunk-shape signature →
+        # compiled executable. Empty (the default) means every dispatch
+        # rides the jitted runner and XLA compiles lazily on first feed;
+        # ``prepare`` fills it so the compile is paid *before* traffic.
+        # ``_exec_fallen`` is the sticky loud-fallback latch, mirroring
+        # ``api._guarded_exec``: one argument-compatibility refusal sends
+        # every later feed to the jitted runner (correctness must never
+        # depend on the warm-start fast path).
+        self._exec: dict = {}
+        self._exec_fallen = False
         self._per_batch: int | None = None
         self._seed = seed
         self.carry: LoopCarry | None = None
@@ -275,9 +296,95 @@ class ChunkedDetector:
         if self.carry is None:
             self.carry = self._init_carry(chunk)
             chunk = jax.tree.map(lambda x: x[:, 1:], chunk)
-        self.carry, flags = self._run_chunk(self.carry, chunk)
+        self.carry, flags = self._dispatch(self.carry, chunk)
         self.batches_done += int(chunk.y.shape[1])
         return flags
+
+    def _dispatch(self, carry: LoopCarry, chunk: Batches):
+        """Run one chunk through the AOT executable when :meth:`prepare`
+        compiled this chunk shape, else the jitted runner (identical
+        semantics — the executable IS the lowered jitted program)."""
+        compiled = None
+        if self._exec and not self._exec_fallen:
+            compiled = self._exec.get(_chunk_sig(chunk))
+        if compiled is None:
+            return self._run_chunk(carry, chunk)
+        try:
+            return compiled(carry, chunk)
+        except (TypeError, ValueError) as e:
+            # Same contract as api._guarded_exec: a layout/sharding/aval
+            # refusal falls back LOUDLY and stickily to the jitted runner;
+            # genuine runtime failures (OOM, dying device) propagate.
+            import warnings
+
+            self._exec_fallen = True
+            warnings.warn(
+                "AOT-compiled chunk program rejected its arguments "
+                f"({type(e).__name__}: {e}); falling back to the jitted "
+                "runner — the lazy XLA compile will land in this feed",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return self._run_chunk(carry, chunk)
+
+    def prepare(self, example_chunk: Batches) -> dict:
+        """AOT warm-start: compile the per-chunk program against
+        ``example_chunk``'s geometry *now*, before any traffic.
+
+        ``jit.lower().compile()`` does not populate the jit dispatch cache,
+        so the executables are kept on the detector and :meth:`feed`
+        dispatches through them directly. On a fresh detector both shapes
+        the serving loop will see are compiled — the first chunk (one
+        microbatch consumed by ``batch_a`` seeding, so ``CB-1`` batches)
+        and the steady-state full chunk; a restored detector (``carry``
+        already set) needs only the latter. With
+        ``RunConfig.compile_cache_dir`` enabled the backend-compile half is
+        additionally served from the persistent cache, so a *restarted*
+        daemon warm-starts in milliseconds — the cold-start collapse the
+        serve subsystem inherits from the r06 AOT work. Returns the timing
+        split ``{aot_seconds, aot_shapes, aot_failed}``; a refusal to
+        lower/compile is LOUD (RuntimeWarning) and leaves the lazy path in
+        charge, never an error.
+        """
+        import time as _time
+
+        chunk = self.place(example_chunk)
+        fresh = self.carry is None
+        template = self.carry if not fresh else self._init_carry(chunk)
+        shaped = []
+        if fresh:
+            shaped.append(jax.tree.map(lambda x: x[:, 1:], chunk))
+        shaped.append(chunk)
+        t0 = _time.perf_counter()
+        compiled_n = 0
+        for s in shaped:
+            sig = _chunk_sig(s)
+            if sig in self._exec:
+                continue
+            try:
+                self._exec[sig] = self._run_chunk.lower(template, s).compile()
+                compiled_n += 1
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    "chunked AOT warm-start failed "
+                    f"({type(e).__name__}: {e}); falling back to lazy "
+                    "compilation — the XLA compile will land inside the "
+                    "first feed of this shape",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return {
+                    "aot_seconds": _time.perf_counter() - t0,
+                    "aot_shapes": compiled_n,
+                    "aot_failed": True,
+                }
+        return {
+            "aot_seconds": _time.perf_counter() - t0,
+            "aot_shapes": compiled_n,
+            "aot_failed": False,
+        }
 
     @staticmethod
     def record_memory_gauges(metrics, when: str = "chunk") -> None:

@@ -565,6 +565,80 @@ def apply_block_policy(
     return arr, ok
 
 
+class RunningColumnStats:
+    """Running finite-cell column means — the streaming complement of the
+    whole-file ``repair`` policy's full-column statistics.
+
+    ``apply_policy``'s repair imputes each NaN feature cell with the mean
+    of its column's finite cells, which needs the whole file up front —
+    exactly what a long-lived ingest daemon cannot have. This accumulator
+    gives the serve admission path (``serve.admission``) the same repair
+    semantics over the *rows admitted so far*: per-column running
+    sum/count of finite cells, updated block by block, queried for the
+    imputation means. Before any evidence a column's mean is 0.0 — the
+    same canonical fill masked rows carry, so an imputed cell can never
+    introduce a value the clean pipeline could not."""
+
+    def __init__(self, num_columns: int):
+        self._sum = np.zeros(num_columns, np.float64)
+        self._count = np.zeros(num_columns, np.int64)
+
+    def update(self, arr: np.ndarray, row_ok: "np.ndarray | None" = None) -> None:
+        """Fold a block's finite cells in (rows with ``row_ok == False``
+        are excluded — quarantined content must not steer the means)."""
+        finite = np.isfinite(arr)
+        if row_ok is not None:
+            finite = finite & np.asarray(row_ok, bool)[:, None]
+        self._sum += np.where(finite, arr, 0.0).sum(axis=0, dtype=np.float64)
+        self._count += finite.sum(axis=0)
+
+    def means(self) -> np.ndarray:
+        """Per-column finite means (f32); 0.0 where no evidence yet."""
+        return (self._sum / np.maximum(self._count, 1)).astype(np.float32)
+
+
+def repair_rows(
+    arr: np.ndarray,
+    issues: list[RowIssue],
+    tcol: int,
+    stats: RunningColumnStats,
+) -> tuple[np.ndarray, list[RowIssue], int]:
+    """Streaming (per-block) repair: the running-stats twin of
+    ``apply_policy``'s whole-file repair branch.
+
+    Repairable issues are fixed in place — non-integral finite labels are
+    rounded, non-finite feature cells imputed from ``stats`` (the means
+    over rows admitted *so far*, not the whole stream — the documented
+    semantic difference from the one-shot loader's repair) — and every
+    non-finite feature cell of a fixable row is imputed, not just the
+    reported one (same all-cells rule as ``apply_policy``). Rows that
+    cannot be repaired (ragged, non-finite label) come back as the
+    remaining issues for the caller to quarantine via
+    :func:`apply_block_policy`. Returns ``(arr, remaining, repaired_rows)``.
+    """
+    if not issues:
+        return arr, [], 0
+    with np.errstate(invalid="ignore"):
+        label_finite = np.isfinite(arr[:, tcol])
+    bad_rows = {
+        i.row
+        for i in issues
+        if not i.repairable or (i.column == tcol and not label_finite[i.row])
+    }
+    fixable = sorted(
+        {i.row for i in issues if i.repairable and i.row not in bad_rows}
+    )
+    means = stats.means() if fixable else None
+    for r in fixable:
+        if label_finite[r] and arr[r, tcol] != np.round(arr[r, tcol]):
+            arr[r, tcol] = np.round(arr[r, tcol])
+        for c in np.nonzero(~np.isfinite(arr[r]))[0]:
+            if c != tcol:
+                arr[r, c] = means[c]
+    remaining = [i for i in issues if i.row in bad_rows]
+    return arr, remaining, len(fixable)
+
+
 def _fast_parse(path: str, header: list[str]) -> "np.ndarray | None":
     """The clean-stream fast path: native multithreaded parser, NumPy
     fallback; ``None`` when the data is malformed (caller falls to the

@@ -36,6 +36,17 @@ Sites (each named where the production code calls :func:`fire`):
                        raising, so the dirty-stream machinery (doctor,
                        quarantine, repair) is exercised by the same
                        seeded injection the process faults use
+``serve.ingress``      per admitted line block in the serving daemon's
+                       ingress (``serve.admission.AdmissionController``)
+                       — corruption kinds mutate the incoming protocol
+                       lines (dirty live traffic); ``raise``/``timeout``
+                       poison the batcher, crashing the daemon loudly
+``serve.flush``        per flushed microbatch, at verdict publication
+                       (``serve.runner.ServeRunner``) — ``raise`` kills
+                       the daemon after the chunk's state advanced but
+                       before its verdict/checkpoint landed (the
+                       kill-and-resume shape); ``kind='torn_write'``
+                       tears the verdict sidecar's trailing line
 =====================  ====================================================
 
 Arming is explicit (:func:`arm` in-process, or the ``DDD_FAULTS`` env var
@@ -93,6 +104,8 @@ SITES = frozenset(
         "checkpoint.save",
         "telemetry.emit",
         "stream.load",
+        "serve.ingress",
+        "serve.flush",
     }
 )
 

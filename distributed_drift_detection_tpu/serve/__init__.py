@@ -1,0 +1,46 @@
+"""Always-on online drift serving (ROADMAP item 2).
+
+The batch pipeline is load → run → exit; this package turns the same
+engines into a long-lived service:
+
+* :mod:`.ingress` — TCP line-protocol listener (CSV/JSON rows, ``FLUSH``
+  / ``STOP`` controls);
+* :mod:`.admission` — sanitize-at-admission (the PR-5
+  ``strict|quarantine|repair`` contract on live traffic) + the
+  fixed-geometry :class:`~.admission.MicroBatcher` with a max-linger
+  deadline — short batches pad through the validity plane, so shapes
+  stay static and nothing recompiles;
+* :mod:`.runner` — the AOT-prepared serving loop over the donated
+  double-buffered :class:`~..engine.chunked.ChunkedDetector`, verdict
+  sidecar + schema-v1 telemetry, checkpointed state, graceful SIGTERM
+  drain;
+* :mod:`.loadgen` — stream replay at a target rows/s with seeded dirty
+  injection and the p50/p99 row→verdict latency SLO report.
+
+Lazy exports (PEP 562): importing the package pulls no jax — the CLIs
+decide what they need.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "AdmissionController": ".admission",
+    "MicroBatcher": ".admission",
+    "SealedChunk": ".admission",
+    "IngressServer": ".ingress",
+    "ServeRunner": ".runner",
+    "find_verdicts": ".runner",
+    "read_verdicts": ".runner",
+    "run_loadgen": ".loadgen",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(_EXPORTS[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,456 @@
+"""Admission control for the serving daemon: sanitize → fixed-geometry
+microbatches.
+
+Two pieces, deliberately separate so each is testable without sockets:
+
+* :class:`AdmissionController` — the data-plane gate. Every ingress line
+  block is parsed with the tolerant row parser and contract-scanned
+  (``io.sanitize.parse_rows`` / ``scan_matrix``), then resolved per the
+  configured policy through the same ``io.sanitize.apply_block_policy``
+  the streaming CSV reader uses — quarantined rows land in the sidecar
+  and count ``ingest_quarantined_total``, exactly as in batch mode. Two
+  serving-specific adaptations, both documented deviations from the
+  batch loaders:
+
+  - ``strict`` rejects the violating *rows* (dropped, counted, an error
+    line back on the connection) instead of refusing the whole stream —
+    a daemon that dies on one bad row is not a daemon;
+  - ``repair`` imputes from **running** column means over the rows
+    admitted so far (``io.sanitize.RunningColumnStats``) — full-column
+    statistics do not exist on an unbounded stream.
+
+  Admitted rows under ``quarantine``/``repair`` keep their stream
+  *positions* (masked, padding-identical inside jit), so a dirty served
+  stream produces flags bit-identical to the clean-masked batch run —
+  the PR-5 acceptance, extended to the wire.
+
+* :class:`MicroBatcher` — the geometry gate. Admitted rows accumulate in
+  arrival order; a full ``[P, CB, B]`` grid seals immediately, a partial
+  one seals when its oldest row has lingered past ``linger_s``. Sealing
+  runs the rows through the one shared striper (``io.stream.stripe_chunk``
+  with the RunConfig's host shuffle seed), so a short flush is *literally*
+  the same chunk as a full grid with the tail masked — static shapes,
+  nothing recompiles, and the serving path cannot drift from the batch
+  path's placement. The stream position advances by the full grid span
+  per seal (grid-slot semantics): under sustained load there are no gaps,
+  and a lingering flush trades position density for latency, never
+  correctness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from ..io import sanitize
+from ..io.stream import stripe_chunk
+from ..resilience import faults
+
+
+class SealedChunk(NamedTuple):
+    """One flushed microbatch: the striped ``[P, CB, B]`` chunk plus its
+    accounting meta (``chunk`` index, ``start_row`` grid position,
+    ``rows`` admitted into it, ``rows_through`` cumulative admitted rows
+    up to and including it — the loadgen's latency-attribution key —
+    ``short`` flag and seal wall-clock)."""
+
+    chunk: object  # engine.loop.Batches
+    meta: dict
+
+
+class MicroBatcher:
+    """Thread-safe accumulation of admitted rows into fixed-geometry
+    chunks with a max-linger deadline (see module docstring).
+
+    Producers call :meth:`push` (ingress handler threads); the single
+    consumer (the serve loop) calls :meth:`get`. :meth:`poison` carries a
+    producer-side failure to the consumer — the daemon must die loudly,
+    not serve around a broken ingress.
+    """
+
+    def __init__(
+        self,
+        partitions: int,
+        per_batch: int,
+        chunk_batches: int,
+        *,
+        shuffle_seed: "int | None" = None,
+        linger_s: float = 0.25,
+        start_row: int = 0,
+        chunk_index: int = 0,
+        rows_admitted: int = 0,
+        max_queue: int = 64,
+    ):
+        self.partitions = partitions
+        self.per_batch = per_batch
+        self.chunk_batches = chunk_batches
+        self.rows_per_chunk = partitions * per_batch * chunk_batches
+        self.shuffle_seed = shuffle_seed
+        self.linger_s = linger_s
+        self.start_row = int(start_row)  # next chunk's grid position
+        self.chunk_index = int(chunk_index)
+        self.rows_admitted = int(rows_admitted)  # cumulative, incl. masked
+        self._max_queue = max(1, max_queue)
+        self._cv = threading.Condition()
+        self._X: list[np.ndarray] = []
+        self._y: list[np.ndarray] = []
+        self._ok: list["np.ndarray | None"] = []
+        self._buffered = 0
+        self._first_ts: "float | None" = None  # monotonic, oldest buffered row
+        self._queue: list[SealedChunk] = []
+        self._error: "BaseException | None" = None
+
+    def push(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        ok: "np.ndarray | None" = None,
+    ) -> None:
+        """Admit a block of rows (arrival order = stream order). Blocks
+        while the sealed-chunk queue is full (backpressure to ingress)."""
+        X = np.ascontiguousarray(X, np.float32)
+        y = np.ascontiguousarray(y, np.int32)
+        if len(X) == 0:
+            return
+        with self._cv:
+            while len(self._queue) >= self._max_queue and self._error is None:
+                self._cv.wait(0.1)
+            if self._error is not None:
+                raise self._error
+            self._X.append(X)
+            self._y.append(y)
+            self._ok.append(None if ok is None else np.asarray(ok, bool))
+            self._buffered += len(X)
+            self.rows_admitted += len(X)
+            if self._first_ts is None:
+                self._first_ts = time.monotonic()
+            while self._buffered >= self.rows_per_chunk:
+                self._seal_locked(self.rows_per_chunk)
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Seal the partial grid now (protocol ``FLUSH`` / drain)."""
+        with self._cv:
+            if self._buffered:
+                self._seal_locked(self._buffered)
+            self._cv.notify_all()
+
+    def poison(self, exc: BaseException) -> None:
+        """Fail the consumer: the next/blocked :meth:`get` raises ``exc``."""
+        with self._cv:
+            self._error = exc
+            self._cv.notify_all()
+
+    def empty(self) -> bool:
+        with self._cv:
+            return not self._queue and not self._buffered
+
+    def get(self, timeout: float = 0.0) -> "SealedChunk | None":
+        """Next sealed chunk, sealing a lingering partial when its
+        deadline passed; ``None`` on timeout. Raises a poisoned error."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    raise self._error
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._cv.notify_all()  # wake a backpressured producer
+                    return item
+                now = time.monotonic()
+                if (
+                    self._buffered
+                    and self._first_ts is not None
+                    and now - self._first_ts >= self.linger_s
+                ):
+                    self._seal_locked(self._buffered)
+                    continue
+                waits = [deadline - now]
+                if self._buffered and self._first_ts is not None:
+                    waits.append(self._first_ts + self.linger_s - now)
+                wait = min(waits)
+                if deadline - now <= 0:
+                    return None
+                self._cv.wait(max(wait, 0.001))
+
+    def _seal_locked(self, n_take: int) -> None:
+        X = np.concatenate(self._X) if len(self._X) > 1 else self._X[0]
+        y = np.concatenate(self._y) if len(self._y) > 1 else self._y[0]
+        ok = None
+        if any(o is not None for o in self._ok):
+            ok = np.concatenate(
+                [
+                    np.ones(len(a), bool) if o is None else o
+                    for a, o in zip(self._X, self._ok)
+                ]
+            )
+        take_X, rest_X = X[:n_take], X[n_take:]
+        take_y, rest_y = y[:n_take], y[n_take:]
+        take_ok = rest_ok = None
+        if ok is not None:
+            take_ok, rest_ok = ok[:n_take], ok[n_take:]
+            if take_ok.all():
+                take_ok = None
+        chunk = stripe_chunk(
+            take_X,
+            take_y,
+            self.start_row,
+            self.partitions,
+            self.per_batch,
+            self.chunk_batches,
+            self.shuffle_seed,
+            row_valid=take_ok,
+        )
+        taken_before = self.rows_admitted - self._buffered
+        meta = {
+            "chunk": self.chunk_index,
+            "start_row": self.start_row,
+            "rows": int(n_take),
+            "rows_through": int(taken_before + n_take),
+            "short": n_take < self.rows_per_chunk,
+            "sealed_ts": time.time(),
+        }
+        self._queue.append(SealedChunk(chunk, meta))
+        # Grid-slot semantics: the stream position always advances by the
+        # full grid span, so the next seal stays aligned to P·B (the
+        # stripe-time shuffle's invariance requirement) and a short flush
+        # reads as a grid with a masked tail, never as a re-packed stream.
+        self.start_row += self.rows_per_chunk
+        self.chunk_index += 1
+        self._X = [rest_X] if len(rest_X) else []
+        self._y = [rest_y] if len(rest_y) else []
+        self._ok = [rest_ok] if len(rest_X) and rest_ok is not None else (
+            [None] if len(rest_X) else []
+        )
+        self._buffered = len(rest_X)
+        self._first_ts = time.monotonic() if self._buffered else None
+
+
+def _json_field(v) -> str:
+    """One JSON row value → one CSV field. Non-numeric values become a
+    comma-free non-numeric token, so they reach the contract scan as a
+    dirty CELL (quarantinable) instead of crashing the normalizer — a
+    daemon must never die on one malformed row."""
+    try:
+        return repr(float(v))
+    except (TypeError, ValueError):
+        return str(v).replace(",", ";") or "''"
+
+
+def _json_line_to_csv(line: str) -> str:
+    """Normalize a JSON row (``{"x": [...], "y": l}`` or ``[f..., l]``)
+    to the CSV field form the shared parser consumes; malformed JSON is
+    returned as-is so it flows through the contract scan like any other
+    dirty line (one parse path, one policy)."""
+    import json
+
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    if isinstance(obj, dict):
+        fields = list(obj.get("x") or []) + [obj.get("y")]
+    elif isinstance(obj, list):
+        fields = obj
+    else:
+        return line
+    return ",".join(_json_field(v) for v in fields)
+
+
+class AdmissionController:
+    """The per-block sanitize → push gate (see module docstring).
+
+    ``num_features`` fixes the ingress line contract: every row carries
+    exactly ``num_features + 1`` comma-separated fields with the label
+    LAST (or the JSON forms, normalized to the same fields). Labels must
+    already be integral and in ``0..num_classes-1`` — a daemon cannot
+    re-index classes the way the one-shot loader does; out-of-range
+    labels are contract violations handled by the policy.
+    """
+
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        num_features: int,
+        num_classes: int,
+        *,
+        policy: str = "quarantine",
+        quarantine_path: "str | None" = None,
+        metrics=None,
+        source: str = "ingress",
+    ):
+        sanitize.check_policy(policy)
+        self.batcher = batcher
+        self.num_features = int(num_features)
+        self.num_classes = int(num_classes)
+        self.columns = self.num_features + 1
+        self.tcol = self.num_features  # label last — the line contract
+        self.policy = policy
+        self.source = source
+        self._stats = (
+            sanitize.RunningColumnStats(self.columns)
+            if policy == "repair"
+            else None
+        )
+        self._writer = (
+            sanitize.QuarantineWriter(quarantine_path, policy)
+            if quarantine_path and policy != "strict"
+            else None
+        )
+        self.rows_seen = 0  # ingress data rows consumed (admitted+rejected)
+        self.rows_rejected = 0
+        self.rows_quarantined = 0
+        self.rows_repaired = 0
+        # One admission at a time: handler threads (one per connection)
+        # share this controller, and the absolute-row accounting, running
+        # stats, counters and lazy sidecar writer all assume sequential
+        # blocks. Admission order across connections is arbitrary anyway
+        # (the network already interleaves), so serializing loses nothing.
+        self._lock = threading.Lock()
+        self._c_rows = self._c_quar = self._c_rej = None
+        if metrics is not None:
+            self._c_rows = metrics.counter(
+                "ingest_rows_total", help="Stream rows admitted at ingress"
+            )
+            self._c_quar = metrics.counter(
+                sanitize.QUARANTINE_METRIC, help=sanitize.QUARANTINE_METRIC_HELP
+            )
+            self._c_rej = metrics.counter(
+                "serve_rejected_total",
+                help="Ingress rows refused under data_policy=strict",
+            )
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+    def admit_lines(self, lines: list[str]) -> dict:
+        """Sanitize + admit one block of protocol data lines; returns the
+        block's accounting (``error`` is the strict-rejection message for
+        the connection, None otherwise). Thread-safe (serialized)."""
+        with self._lock:
+            return self._admit_lines_locked(lines)
+
+    def _parse_block(
+        self, lines: list[str]
+    ) -> tuple[np.ndarray, list[sanitize.RowIssue]]:
+        """Fast-path-then-fallback parse, the batch readers' shape: a
+        vectorized parse serves the (overwhelmingly common) clean block;
+        the tolerant per-cell parser runs only when it refuses — ragged
+        rows, non-numeric text. NaN/Inf parse fine on the fast path and
+        are caught by the matrix scan like everywhere else."""
+        import io as _io
+
+        try:
+            arr = np.loadtxt(
+                _io.StringIO("\n".join(lines)),
+                delimiter=",",
+                dtype=np.float32,
+                ndmin=2,
+            )
+            if arr.shape == (len(lines), self.columns):
+                return arr, []
+        except ValueError:
+            pass
+        return sanitize.parse_rows(lines, self.columns)
+
+    def _admit_lines_locked(self, lines: list[str]) -> dict:
+        lines = [
+            _json_line_to_csv(ln) if ln.lstrip()[:1] in "{[" else ln
+            for ln in lines
+            if ln.strip()
+        ]
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # corruption kinds mutate the live protocol lines — dirty traffic
+        # by seeded injection; raise/timeout poison the batcher upstream
+        # (the ingress handler routes the exception there).
+        faults.fire(
+            "serve.ingress",
+            lines=lines,
+            label_col=self.tcol,
+            rows_seen=self.rows_seen,
+        )
+        if not lines:
+            return {"rows": 0, "admitted": 0, "error": None}
+        arr, issues = self._parse_block(lines)
+        flagged = frozenset(i.row for i in issues)
+        issues = issues + sanitize.scan_matrix(arr, self.tcol, flagged=flagged)
+        # Serving-only contract clause: the label domain is configuration
+        # (no re-indexing pass exists on a live stream). Checked on the
+        # ROUNDED label — np.round is exactly what the repair policy will
+        # apply, so a label that would round out of the domain (e.g. 9.6
+        # at 10 classes) is an unrepairable violation here, never an
+        # out-of-range index handed to the engine.
+        y = arr[:, self.tcol]
+        with np.errstate(invalid="ignore"):
+            y_r = np.round(y)
+            in_range = np.isfinite(y) & (y_r >= 0) & (y_r < self.num_classes)
+        for r in np.nonzero(~in_range)[0]:
+            # Appended even when the row already carries another issue: a
+            # repairable one (non-integral label) must not shadow this
+            # UNREPAIRABLE violation, or repair would round the label
+            # straight out of the engine's index domain.
+            issues.append(
+                sanitize.RowIssue(
+                    int(r),
+                    self.tcol,
+                    f"label {float(y[r])!r} outside the configured "
+                    f"class domain 0..{self.num_classes - 1}",
+                )
+            )
+        issues.sort(key=lambda i: (i.row, -1 if i.column is None else i.column))
+        base = self.rows_seen
+        self.rows_seen += len(arr)
+
+        error = None
+        ok = None
+        if self.policy == "repair" and issues:
+            arr, issues, repaired = sanitize.repair_rows(
+                arr, issues, self.tcol, self._stats
+            )
+            self.rows_repaired += repaired
+        if self.policy == "strict":
+            if issues:
+                bad = sorted({i.row for i in issues})
+                first = issues[0]
+                error = (
+                    f"rejected {len(bad)} row(s); first: data row "
+                    f"{base + first.row}"
+                    + ("" if first.column is None else f", column {first.column}")
+                    + f": {first.reason}"
+                )
+                self.rows_rejected += len(bad)
+                if self._c_rej is not None:
+                    self._c_rej.inc(len(bad))
+                keep = np.ones(len(arr), bool)
+                keep[bad] = False
+                arr = arr[keep]
+        else:
+            arr, ok = sanitize.apply_block_policy(
+                arr,
+                issues,
+                path=self.source,
+                policy=self.policy,
+                base_row=base,
+                writer=self._writer,
+            )
+            if ok is not None:
+                n_bad = int((~ok).sum())
+                self.rows_quarantined += n_bad
+                if self._c_quar is not None:
+                    self._c_quar.inc(n_bad)
+        if self._stats is not None and len(arr):
+            self._stats.update(arr, ok)
+        admitted = len(arr)
+        if admitted:
+            if self._c_rows is not None:
+                self._c_rows.inc(admitted)
+            self.batcher.push(
+                arr[:, : self.num_features],
+                arr[:, self.tcol].astype(np.int32),
+                ok,
+            )
+        return {"rows": len(lines), "admitted": admitted, "error": error}

@@ -1,0 +1,132 @@
+"""TCP line-protocol ingress for the serving daemon.
+
+The wire contract (newline-delimited UTF-8, one row per line):
+
+* ``v1,...,vF,label`` — CSV fields, label **last** (``F`` =
+  ``ServeParams.num_features``);
+* ``{"x": [v1, ..., vF], "y": label}`` or ``[v1, ..., vF, label]`` —
+  JSON rows, normalized to the same fields at admission;
+* ``FLUSH`` — seal the current partial microbatch now (clients use it to
+  close out a replay instead of waiting for the linger deadline);
+* ``STOP`` — request a graceful drain (same path as SIGTERM: in-flight
+  batches flush, the final checkpoint lands, the registry record flips
+  to completed).
+
+The server never acknowledges data lines (throughput; verdicts are
+published through the run log + verdict sidecar, see ``serve.runner``).
+The one response is ``ERR <reason>`` when ``data_policy='strict'``
+rejects rows from this connection's traffic.
+
+Handlers admit rows in *recv-sized blocks*: whatever complete lines one
+``recv`` delivered go through ``AdmissionController.admit_lines`` as a
+single block, so sanitize cost amortizes under load while a trickling
+client still admits per line. An admission failure (an armed
+``serve.ingress`` fault, an unexpected bug) poisons the batcher — the
+serve loop re-raises it and the daemon dies loudly rather than serving
+around a broken ingress.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+_RECV_BYTES = 1 << 16
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        buf = b""
+        while True:
+            try:
+                data = self.request.recv(_RECV_BYTES)
+            except OSError:
+                break
+            if not data:
+                break
+            buf += data
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                continue
+            block, buf = buf[:cut], buf[cut + 1 :]
+            self._process(block.decode("utf-8", errors="replace").split("\n"))
+        if buf.strip():
+            self._process([buf.decode("utf-8", errors="replace")])
+
+    def _process(self, lines: list[str]) -> None:
+        server: "IngressServer" = self.server  # type: ignore[assignment]
+        block: list[str] = []
+        for ln in lines:
+            s = ln.strip()
+            if not s:
+                continue
+            if s == "FLUSH":
+                self._admit(block)
+                block = []
+                server.batcher.flush()
+            elif s == "STOP":
+                self._admit(block)
+                block = []
+                server.on_stop()
+            else:
+                block.append(s)
+        self._admit(block)
+
+    def _admit(self, block: list[str]) -> None:
+        if not block:
+            return
+        server: "IngressServer" = self.server  # type: ignore[assignment]
+        try:
+            res = server.admission.admit_lines(block)
+        except BaseException as e:
+            # The daemon must die loudly on an ingress-path failure (the
+            # armed serve.ingress fault is the rehearsal): poison the
+            # batcher so the serve loop re-raises, tell the client, and
+            # end this connection.
+            server.batcher.poison(e)
+            self._send(f"ERR {type(e).__name__}: {e}")
+            raise
+        if res.get("error"):
+            self._send("ERR " + res["error"])
+
+    def _send(self, line: str) -> None:
+        try:
+            self.request.sendall((line + "\n").encode())
+        except OSError:
+            pass  # client already gone; the counters carry the evidence
+
+
+class IngressServer(socketserver.ThreadingTCPServer):
+    """The listener: one daemon thread accepting, one per connection.
+
+    ``on_stop`` is the runner's graceful-drain hook (the ``STOP``
+    protocol line); :attr:`batcher`/:attr:`admission` are shared with the
+    serve loop. ``server_address`` after construction carries the bound
+    port (``port=0`` requests an OS-assigned one).
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, host: str, port: int, admission, batcher, on_stop):
+        super().__init__((host, port), _Handler)
+        self.admission = admission
+        self.batcher = batcher
+        self.on_stop = on_stop
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="serve-ingress", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,290 @@
+"""Load generator + latency SLO probe for the serving daemon.
+
+    python -m distributed_drift_detection_tpu loadgen synth:rialto,seed=0 \\
+        --port 7007 --rows 4000 --rate 2000 --dir runs/live [...]
+
+Replays a stream — an ``io.synth`` spec (``synth:rialto,...``) or a CSV
+file — over the ingress line protocol at a target sustained rate, with
+optional seeded dirty-row injection through the same
+``resilience.faults.corrupt_lines`` helper the batch fault site uses
+(``--dirty nan_cell:5:7`` corrupts 5 seeded rows), then tails the
+daemon's verdict sidecar and reports **achieved rows/s plus p50/p99
+row→verdict latency** as one JSON line — the SLO evidence ``bench.py
+--serve`` records and the ``perf`` CLI tracks informationally.
+
+Latency attribution: every verdict record carries ``rows_through`` — the
+cumulative count of admitted rows up to and including its microbatch —
+and rows are admitted in arrival order, so sent row *i*'s verdict is the
+first record with ``rows_through > i``. Its latency is the verdict's
+publication wall-clock minus the row's send wall-clock (same host for
+generator and daemon in every supported deployment of this probe).
+Under ``strict`` with dirty traffic rejected rows shift the mapping —
+drive dirty SLO runs under ``quarantine``/``repair`` (rows keep their
+positions; the loadgen default matches the daemon's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+
+def load_source(
+    spec: str, target_column: str = "target"
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Resolve a stream source to ``(X, y, num_classes)`` with labels
+    re-indexed to ``0..C-1`` (the serve ingress contract — a daemon
+    cannot re-index, so the generator does)."""
+    if spec.startswith("synth:"):
+        from ..io.synth import parse_synth
+
+        X, y = parse_synth(spec[len("synth:"):])
+    else:
+        from ..io.stream import load_csv
+
+        X, y = load_csv(spec, target_column)
+    classes, y_idx = np.unique(y, return_inverse=True)
+    return (
+        np.ascontiguousarray(X, np.float32),
+        y_idx.astype(np.int32),
+        len(classes),
+    )
+
+
+def format_lines(X: np.ndarray, y: np.ndarray) -> list[str]:
+    """Rows → protocol CSV lines (label last). ``repr(float(v))``
+    round-trips every f32 exactly through the daemon's parser, so a
+    clean replay is bit-identical to feeding the arrays directly."""
+    return [
+        ",".join(repr(float(v)) for v in row) + f",{int(label)}"
+        for row, label in zip(X, y)
+    ]
+
+
+def apply_dirty(
+    lines: list[str], spec: str
+) -> list[tuple[int, int]]:
+    """Apply one ``--dirty kind[:rows[:seed]]`` spec in place via
+    ``resilience.faults.corrupt_lines``; returns the corrupted
+    ``(row, column)`` pairs."""
+    from ..resilience.faults import corrupt_lines
+
+    parts = spec.split(":")
+    kind = parts[0]
+    rows = int(parts[1]) if len(parts) > 1 else 1
+    seed = int(parts[2]) if len(parts) > 2 else 0
+    return corrupt_lines(lines, kind, rows=rows, seed=seed, label_col=-1)
+
+
+class _VerdictTail:
+    """Incremental verdict-sidecar reader (torn-tail tolerant: the offset
+    only advances past complete lines, like ``telemetry.watch.LogTail``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> list[dict]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path, "rb") as fh:
+            fh.seek(self._offset)
+            blob = fh.read()
+        end = blob.rfind(b"\n")
+        if end < 0:
+            return []
+        chunk = blob[: end + 1]
+        self._offset += end + 1
+        out = []
+        for line in chunk.decode("utf-8", errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if isinstance(rec, dict) and rec.get("kind") == "verdict":
+                out.append(rec)
+        return out
+
+
+def _connect(host: str, port: int, timeout: float) -> socket.socket:
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return socket.create_connection((host, port), timeout=5)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _send_rows(
+    sock: socket.socket, lines: list[str], rate: float, batch: int = 256
+) -> np.ndarray:
+    """Send data lines paced to ``rate`` rows/s (0 = as fast as the
+    socket takes them); returns per-row send wall-clock stamps."""
+    send_ts = np.empty(len(lines), np.float64)
+    start = time.monotonic()
+    i = 0
+    while i < len(lines):
+        if rate > 0:
+            due = int((time.monotonic() - start) * rate) + 1
+            if due <= i:
+                time.sleep(min(0.002, 1.0 / rate))
+                continue
+            j = min(len(lines), i + min(batch, due - i))
+        else:
+            j = min(len(lines), i + batch)
+        sock.sendall(("\n".join(lines[i:j]) + "\n").encode())
+        send_ts[i:j] = time.time()
+        i = j
+    return send_ts
+
+
+def run_loadgen(
+    host: str,
+    port: int,
+    lines: list[str],
+    *,
+    rate: float = 0.0,
+    verdicts: "str | None" = None,
+    timeout: float = 60.0,
+    flush: bool = True,
+    stop: bool = False,
+    connect_timeout: float = 30.0,
+    expect_rows: "int | None" = None,
+) -> dict:
+    """Drive one replay and measure the SLO (see module docstring).
+    ``expect_rows`` overrides how many admitted rows the verdict stream
+    must cover before the probe stops waiting (default: all sent)."""
+    tail = _VerdictTail(verdicts) if verdicts else None
+    baseline = 0
+    if tail is not None:
+        # Rows already verdicted by earlier traffic (a warm daemon):
+        # this replay's row i sits at admitted position baseline + i.
+        for rec in tail.poll():
+            baseline = max(baseline, int(rec["rows_through"]))
+    sock = _connect(host, port, connect_timeout)
+    try:
+        t0 = time.monotonic()
+        send_ts = _send_rows(sock, lines, rate)
+        sent_span = time.monotonic() - t0
+        if flush:
+            sock.sendall(b"FLUSH\n")
+        if stop:
+            sock.sendall(b"STOP\n")
+    finally:
+        sock.close()
+    sent = len(lines)
+    expect = baseline + (expect_rows if expect_rows is not None else sent)
+    records: list[dict] = []
+    covered = baseline
+    timed_out = False
+    if tail is not None:
+        deadline = time.monotonic() + timeout
+        while covered < expect:
+            fresh = tail.poll()
+            if fresh:
+                records.extend(fresh)
+                covered = max(covered, *(int(r["rows_through"]) for r in fresh))
+                continue
+            if time.monotonic() >= deadline:
+                timed_out = True
+                break
+            time.sleep(0.02)
+    lat_ms: list[float] = []
+    if records:
+        recs = sorted(records, key=lambda r: int(r["rows_through"]))
+        throughs = np.array([int(r["rows_through"]) for r in recs])
+        ts = np.array([float(r["ts"]) for r in recs])
+        pos = baseline + np.arange(sent)
+        idx = np.searchsorted(throughs, pos, side="right")
+        ok = idx < len(recs)
+        lat_ms = ((ts[idx[ok]] - send_ts[ok]) * 1000.0).tolist()
+    report = {
+        "rows_sent": sent,
+        "rows_covered": len(lat_ms),
+        "verdicts": len(records),
+        "detections": sum(int(r["detections"]) for r in records),
+        "achieved_rows_per_sec": (
+            round(sent / sent_span, 1) if sent_span > 0 else None
+        ),
+        "target_rows_per_sec": rate or None,
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if lat_ms else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if lat_ms else None,
+        "mean_ms": round(float(np.mean(lat_ms)), 2) if lat_ms else None,
+        "timeout": timed_out,
+    }
+    return report
+
+
+def main(argv=None) -> None:
+    """``loadgen``: replay a stream at a target rate and report the SLO."""
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu loadgen",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("source", help="synth:SPEC (io.synth.parse_synth) or a CSV path")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--rows", type=int, default=None,
+                    help="cap the replay at N rows (default: the whole source)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="target rows/s (0 = as fast as the socket takes them)")
+    ap.add_argument("--dirty", action="append", default=[],
+                    metavar="KIND[:ROWS[:SEED]]",
+                    help="seeded dirty-row injection (nan_cell|bad_label|"
+                    "ragged_row), repeatable")
+    ap.add_argument("--verdicts", default=None,
+                    help="verdict sidecar path (row→verdict latency source)")
+    ap.add_argument("--dir", dest="telemetry_dir", default=None,
+                    help="telemetry directory: resolve the newest verdict "
+                    "sidecar in it")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="max seconds to wait for verdict coverage")
+    ap.add_argument("--stop", action="store_true",
+                    help="send STOP after the replay (drain the daemon)")
+    ap.add_argument("--target-column", default="target")
+    args = ap.parse_args(argv)
+
+    X, y, num_classes = load_source(args.source, args.target_column)
+    if args.rows is not None:
+        X, y = X[: args.rows], y[: args.rows]
+    lines = format_lines(X, y)
+    dirty_rows = 0
+    for spec in args.dirty:
+        dirty_rows += len(apply_dirty(lines, spec))
+    verdicts = args.verdicts
+    if verdicts is None and args.telemetry_dir:
+        from .runner import find_verdicts
+
+        verdicts = find_verdicts(args.telemetry_dir)
+        if verdicts is None:
+            ap.error(f"no verdict sidecar under {args.telemetry_dir}")
+    report = run_loadgen(
+        args.host,
+        args.port,
+        lines,
+        rate=args.rate,
+        verdicts=verdicts,
+        timeout=args.timeout,
+        stop=args.stop,
+    )
+    report.update(
+        source=args.source,
+        features=int(X.shape[1]),
+        classes=num_classes,
+        dirty_rows=dirty_rows,
+    )
+    print(json.dumps(report))
+    raise SystemExit(2 if report["timeout"] else 0)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,604 @@
+"""The always-on serving loop: AOT-prepared chunked detection over live
+admitted traffic, with verdict publication, checkpointed state, and a
+graceful drain.
+
+    python -m distributed_drift_detection_tpu serve \\
+        --features 27 --classes 10 --telemetry-dir runs/live [...]
+
+One :class:`ServeRunner` owns the whole lifecycle:
+
+* **prepare** — ``api.prepare_chunked`` resolves the RunConfig into an
+  AOT-warmed :class:`~..engine.chunked.ChunkedDetector` (both chunk
+  shapes compiled before the first row arrives; with
+  ``RunConfig.compile_cache_dir`` a restarted daemon warm-starts from the
+  persistent cache), and a checkpoint at ``ServeParams.checkpoint``
+  restores the detector carry + stream position — the kill-and-resume
+  contract.
+* **serve** — sealed microbatches from the admission layer feed the
+  detector through the donated ``place()`` double-buffer (chunk k+1's
+  host→device upload dispatches while chunk k computes; pipeline depth
+  drops to 1 when ``checkpoint_every == 1`` so every checkpoint describes
+  exactly the published prefix). Each chunk's **verdict** — detection
+  count, per-partition change positions, stream-position accounting — is
+  appended to a ``<run-log>.verdicts.jsonl`` sidecar (flushed per line,
+  torn-tail tolerant like every sink here), and the run log receives the
+  same ``chunk_completed`` / ``heartbeat`` / ``drift_detected`` events a
+  batch run would — so ``watch``, ``report`` and ``correlate`` work
+  unchanged against a live service.
+* **drain** — SIGTERM/SIGINT (or the protocol ``STOP`` line) stops the
+  ingress, flushes the partial microbatch through the validity plane,
+  publishes everything in flight, writes an atomic final checkpoint, and
+  flips the registry record to ``completed``.
+
+The ``serve.flush`` fault site fires at verdict publication —
+``kind='raise'`` kills the daemon after a chunk's state advanced but
+before its verdict/checkpoint landed (the crash the resume test
+rehearses); ``torn_write`` tears the verdict sidecar's trailing line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..config import (
+    RunConfig,
+    ServeParams,
+    host_shuffle_seed,
+    replace,
+    telemetry_config_payload,
+)
+from ..resilience import faults
+from .admission import AdmissionController, MicroBatcher
+
+VERDICT_VERSION = 1
+
+VERDICT_SUFFIX = ".verdicts.jsonl"
+
+
+def reconcile_torn_tail(path: str) -> bool:
+    """Drop a torn trailing line (no final newline) from an append-only
+    JSONL sidecar; returns True when something was truncated.
+
+    A crash mid-append leaves a partial last line — tolerable to every
+    reader here (``allow_partial_tail``) *as long as it stays the last
+    line*. A resumed daemon about to APPEND must remove it first, or the
+    next record would concatenate into a permanently corrupt interior
+    line no reader tolerates."""
+    if not os.path.exists(path):
+        return False
+    with open(path, "rb+") as fh:
+        data = fh.read()
+        if not data or data.endswith(b"\n"):
+            return False
+        cut = data.rfind(b"\n")
+        fh.truncate(cut + 1)
+    return True
+
+
+def find_verdicts(telemetry_dir: str) -> "str | None":
+    """Newest verdict sidecar in a telemetry directory (mtime order) —
+    how ``loadgen`` locates a live daemon's verdict stream."""
+    paths = glob.glob(os.path.join(telemetry_dir, "*" + VERDICT_SUFFIX))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def read_verdicts(path: str, *, allow_partial_tail: bool = True) -> list[dict]:
+    """Parse a verdict sidecar; tolerates one torn trailing line (the
+    writer flushes per line — same crash/live-tail contract as the event
+    log and quarantine sidecars)."""
+    records = []
+    with open(path) as fh:
+        lines = fh.readlines()
+    for lineno, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        try:
+            rec = json.loads(stripped)
+        except json.JSONDecodeError:
+            if allow_partial_tail and lineno == len(lines):
+                break
+            raise ValueError(f"{path}:{lineno}: corrupt verdict record")
+        if isinstance(rec, dict) and rec.get("kind") == "verdict":
+            records.append(rec)
+    return records
+
+
+class ServeRunner:
+    """Lifecycle owner of one serving daemon (see module docstring).
+
+    ``keep_flags=True`` additionally accumulates every published chunk's
+    host flag table — the in-process embedding tests use it for
+    bit-parity against ``api.run``; a production daemon leaves it off
+    (unbounded memory on an unbounded stream).
+    """
+
+    def __init__(
+        self,
+        cfg: RunConfig,
+        params: ServeParams,
+        *,
+        keep_flags: bool = False,
+        max_chunks: "int | None" = None,
+    ):
+        if params.num_features <= 0 or params.num_classes <= 0:
+            raise ValueError(
+                "ServeParams.num_features/num_classes must be explicit "
+                f"(> 0), got {params.num_features}/{params.num_classes}"
+            )
+        self.cfg = replace(cfg, app_name=cfg.app_name or "serve")
+        self.params = params
+        self._stop = threading.Event()
+        self._keep = [] if keep_flags else None
+        self._max_chunks = max_chunks
+        self.det = None
+        self.batcher: "MicroBatcher | None" = None
+        self.admission: "AdmissionController | None" = None
+        self._ingress = None
+        self._log = None
+        self._metrics = None
+        self._verdict_fh = None
+        self.verdicts_path: "str | None" = None
+        self._flag_base = 0  # flag columns published == batches published
+        self._published = 0  # chunks published this process
+        self._ckpt_at = 0
+        self._rows_published = 0
+        self._detections = 0
+        self._last_meta: "dict | None" = None
+        self._t_start: "float | None" = None
+        self.resumed_meta: "dict | None" = None
+        # Pipeline depth: 2 = double-buffered (chunk k+1 uploads while k
+        # computes); 1 when every chunk checkpoints, so the carry on disk
+        # always describes exactly the published verdict prefix.
+        self._depth = (
+            1 if (params.checkpoint and params.checkpoint_every <= 1) else 2
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> dict:
+        """Open telemetry, restore state, AOT-prepare, start the ingress;
+        returns the startup banner (host/port/artifact paths)."""
+        from ..api import prepare_chunked
+        from ..io.stream import stripe_chunk
+
+        cfg, params = self.cfg, self.params
+        self._t_start = time.monotonic()
+        ident = None
+        if cfg.telemetry_dir:
+            from ..parallel.multihost import host_identity
+            from ..telemetry.events import EventLog
+            from ..telemetry.metrics import MetricsRegistry
+
+            ident = host_identity()
+            self._log = EventLog.open_run(
+                cfg.telemetry_dir,
+                name=cfg.resolved_app_name(),
+                process_index=ident["process_index"],
+            )
+            self._metrics = MetricsRegistry()
+        stem = (
+            os.path.splitext(self._log.path)[0]
+            if self._log is not None
+            else "serve"
+        )
+        self.verdicts_path = stem + VERDICT_SUFFIX
+
+        self.det, compile_info = prepare_chunked(
+            cfg,
+            params.num_features,
+            params.num_classes,
+            chunk_batches=params.chunk_batches,
+        )
+        resume = None
+        if params.checkpoint and os.path.exists(params.checkpoint):
+            example = stripe_chunk(
+                np.zeros((0, params.num_features), np.float32),
+                np.zeros((0,), np.int32),
+                0,
+                cfg.partitions,
+                cfg.per_batch,
+                params.chunk_batches,
+            )
+            resume = self.det.restore(params.checkpoint, example_chunk=example)
+            self.det.rows_done = int(resume.get("rows_done", 0))
+            self._flag_base = int(resume.get("flag_cols", 0))
+            self._published = int(resume.get("chunk_index", 0))
+            self._ckpt_at = self._published
+            self._rows_published = int(resume.get("rows_admitted", 0))
+            self._detections = int(resume.get("detections", 0))
+            self.resumed_meta = resume
+        # A FRESH daemon starts a fresh verdict stream: truncate, so a
+        # reused (untelemetered) path from an earlier run cannot leave a
+        # non-monotone rows_through sequence behind. A resumed daemon
+        # appends — its records continue the previous accounting — after
+        # dropping any torn trailing line the crash left, so the resume
+        # never manufactures a corrupt interior line.
+        # (Telemetered daemons get unique per-run-log stems either way.)
+        if resume is not None:
+            reconcile_torn_tail(self.verdicts_path)
+        self._verdict_fh = open(
+            self.verdicts_path, "a" if resume is not None else "w"
+        )
+        self.batcher = MicroBatcher(
+            cfg.partitions,
+            cfg.per_batch,
+            params.chunk_batches,
+            shuffle_seed=host_shuffle_seed(cfg),
+            linger_s=params.linger_s,
+            start_row=int(resume.get("stream_row", 0)) if resume else 0,
+            chunk_index=int(resume.get("chunk_index", 0)) if resume else 0,
+            rows_admitted=(
+                int(resume.get("rows_admitted", 0)) if resume else 0
+            ),
+        )
+        self.admission = AdmissionController(
+            self.batcher,
+            params.num_features,
+            params.num_classes,
+            policy=cfg.data_policy,
+            quarantine_path=(
+                cfg.quarantine_path or stem + ".quarantine.jsonl"
+            ),
+            metrics=self._metrics,
+        )
+        if self._log is not None:
+            from ..telemetry import registry as run_registry
+
+            payload = telemetry_config_payload(cfg)
+            self._log.emit(
+                "run_started",
+                run_id=self._log.run_id,
+                config=payload,
+                serve={
+                    "chunk_batches": params.chunk_batches,
+                    "linger_s": params.linger_s,
+                    "checkpoint": params.checkpoint,
+                    "resumed": resume is not None,
+                },
+                **(ident or {}),
+            )
+            self._log.emit(
+                "compile_completed",
+                cached=compile_info.get("cached", False),
+                seconds=compile_info.get("build_seconds", 0.0),
+                aot_seconds=compile_info.get("aot_seconds", 0.0),
+                aot_shapes=compile_info.get("aot_shapes", 0),
+            )
+            run_registry.record(
+                cfg.telemetry_dir,
+                self._log.run_id,
+                "running",
+                kind="serve",
+                config_digest=run_registry.config_digest(payload),
+                log=os.path.basename(self._log.path),
+                resumed=resume is not None,
+                **(ident or {}),
+            )
+        if params.port is not None:
+            from .ingress import IngressServer
+
+            self._ingress = IngressServer(
+                params.host,
+                params.port,
+                self.admission,
+                self.batcher,
+                self.request_stop,
+            )
+            self._ingress.start()
+        return {
+            "serving": True,
+            "host": params.host,
+            "port": self._ingress.port if self._ingress is not None else None,
+            "pid": os.getpid(),
+            "run_log": self._log.path if self._log is not None else None,
+            "verdicts": self.verdicts_path,
+            "checkpoint": params.checkpoint or None,
+            "resumed": resume is not None,
+        }
+
+    def request_stop(self) -> None:
+        """Graceful drain (signal handlers and the STOP line land here).
+        Thread-safe and idempotent; the serve loop performs the drain."""
+        self._stop.set()
+
+    # -- the loop ------------------------------------------------------------
+
+    def serve_forever(self) -> int:
+        """Run until a drain completes; returns 0. Exceptions (poisoned
+        ingress, armed faults, device failures) record ``failed`` in the
+        registry and propagate — a crashed daemon must read as crashed."""
+        import jax  # noqa: F401  (placed/fed chunks are device work)
+
+        params = self.params
+        inflight: list[tuple] = []
+        last_hb = time.monotonic()
+        stop_handled = False
+        try:
+            while True:
+                if self._stop.is_set() and not stop_handled:
+                    stop_handled = True
+                    if self._ingress is not None:
+                        self._ingress.stop()
+                    self.batcher.flush()
+                item = self.batcher.get(0.0 if inflight else params.poll_s)
+                if item is not None:
+                    flags = self.det.feed(self.det.place(item.chunk))
+                    inflight.append((flags, item.meta))
+                if inflight and (item is None or len(inflight) >= self._depth):
+                    self._publish(*inflight.pop(0))
+                    if (
+                        params.checkpoint
+                        and self._published - self._ckpt_at
+                        >= max(params.checkpoint_every, 1)
+                    ):
+                        # A checkpoint must describe exactly the published
+                        # prefix, and the donated carry always reflects the
+                        # last FED chunk — so drain the pipeline first (one
+                        # deliberate bubble per checkpoint_every chunks;
+                        # depth 1 makes this a no-op).
+                        while inflight:
+                            self._publish(*inflight.pop(0))
+                        self._save_checkpoint()
+                        self._ckpt_at = self._published
+                if (
+                    self._log is not None
+                    and time.monotonic() - last_hb >= params.heartbeat_s
+                ):
+                    self.det.emit_heartbeat(self._log)
+                    last_hb = time.monotonic()
+                if (
+                    self._max_chunks is not None
+                    and self._published >= self._max_chunks
+                ):
+                    self._stop.set()
+                if stop_handled and not inflight and self.batcher.empty():
+                    break
+            self._finish()
+            return 0
+        except BaseException:
+            self._fail()
+            raise
+
+    def _publish(self, flags, meta: dict) -> None:
+        """Collect one chunk's flags host-side and publish its verdict
+        (the row→verdict latency endpoint)."""
+        import jax
+
+        host = jax.tree.map(np.asarray, flags)
+        cg = np.asarray(host.change_global)
+        changed = cg >= 0
+        changes = [
+            [int(p), int(b), int(cg[p, b])]
+            for b, p in zip(*np.nonzero(changed.T))
+        ]
+        record = {
+            "v": VERDICT_VERSION,
+            "kind": "verdict",
+            "ts": time.time(),
+            "chunk": meta["chunk"],
+            "start_row": meta["start_row"],
+            "rows": meta["rows"],
+            "rows_through": meta["rows_through"],
+            "short": meta["short"],
+            "flag_base": self._flag_base,
+            "cols": int(cg.shape[1]),
+            "detections": int(changed.sum()),
+            "changes": changes,
+        }
+        line = json.dumps(record)
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # raise = die after the chunk's state advanced but before its
+        # verdict landed; torn_write = tear the sidecar's trailing line.
+        faults.fire(
+            "serve.flush",
+            fh=self._verdict_fh,
+            payload=line,
+            chunk=meta["chunk"],
+        )
+        self._verdict_fh.write(line + "\n")
+        self._verdict_fh.flush()
+        self._flag_base += int(cg.shape[1])
+        self._published += 1
+        self._rows_published = int(meta["rows_through"])
+        self._detections += int(changed.sum())
+        self._last_meta = meta
+        if self._keep is not None:
+            self._keep.append(host)
+        if self._log is not None:
+            from ..telemetry.events import emit_flag_events
+
+            self.det.emit_chunk_event(
+                self._log, meta["chunk"], host, self._metrics
+            )
+            self.det.emit_heartbeat(self._log)
+            emit_flag_events(self._log, cg, np.asarray(host.forced_retrain), 0)
+
+    def _save_checkpoint(self) -> None:
+        if self.det.carry is None or self._last_meta is None:
+            return
+        from ..utils.checkpoint import save_checkpoint
+
+        meta = self._last_meta
+        save_checkpoint(
+            self.params.checkpoint,
+            self.det.carry,
+            meta={
+                # flag columns == batches consumed (the first chunk's
+                # batch_a microbatch emits no flag row), so the published
+                # prefix and the carry agree by construction — checkpoints
+                # are only written when nothing is in flight.
+                "batches_done": self._flag_base,
+                "partitions": self.det.partitions,
+                "stream_row": meta["start_row"] + self.batcher.rows_per_chunk,
+                "chunk_index": meta["chunk"] + 1,
+                "rows_admitted": meta["rows_through"],
+                "flag_cols": self._flag_base,
+                "rows_done": self.det.rows_done,
+                "detections": self._detections,
+            },
+        )
+
+    def _finish(self) -> None:
+        if self.params.checkpoint and self.det.carry is not None:
+            self._save_checkpoint()
+        elapsed = time.monotonic() - self._t_start
+        if self._log is not None:
+            from ..telemetry import registry as run_registry
+            from ..telemetry.metrics import write_exports
+
+            self._log.emit(
+                "run_completed",
+                rows=self._rows_published,
+                seconds=elapsed,
+                detections=self._detections,
+                chunks=self._published,
+                rows_quarantined=self.admission.rows_quarantined,
+                rows_rejected=self.admission.rows_rejected,
+            )
+            run_registry.record(
+                self.cfg.telemetry_dir,
+                self._log.run_id,
+                "completed",
+                rows=self._rows_published,
+                seconds=elapsed,
+                detections=self._detections,
+            )
+            write_exports(
+                self._metrics, os.path.splitext(self._log.path)[0]
+            )
+            self._log.close()
+        self._close_files()
+
+    def _fail(self) -> None:
+        try:
+            if self._ingress is not None:
+                self._ingress.stop()
+        except Exception:
+            pass
+        if self._log is not None:
+            try:
+                from ..telemetry import registry as run_registry
+
+                run_registry.record(
+                    self.cfg.telemetry_dir, self._log.run_id, "failed"
+                )
+            except Exception:
+                pass  # best-effort crash evidence (api.run's posture)
+            self._log.close()
+        self._close_files()
+
+    def _close_files(self) -> None:
+        if self._verdict_fh is not None and not self._verdict_fh.closed:
+            self._verdict_fh.close()
+        if self.admission is not None:
+            self.admission.close()
+
+    # -- test/bench surface --------------------------------------------------
+
+    def flags(self):
+        """Concatenated host flag tables of every published chunk
+        (requires ``keep_flags=True``)."""
+        from ..engine.loop import FlagRows
+
+        assert self._keep is not None, "construct with keep_flags=True"
+        if not self._keep:
+            return None
+        return FlagRows(
+            *(np.concatenate(xs, axis=1) for xs in zip(*self._keep))
+        )
+
+
+def main(argv=None) -> None:
+    """``serve``: run the online drift-serving daemon until drained."""
+    import signal
+
+    from ..config import DATA_POLICIES, DETECTOR_NAMES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu serve",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--features", type=int, required=True,
+                    help="feature count of every ingress row (label rides last)")
+    ap.add_argument("--classes", type=int, required=True,
+                    help="label domain size (labels must be 0..C-1)")
+    ap.add_argument("--partitions", type=int, default=4)
+    ap.add_argument("--per-batch", type=int, default=50)
+    ap.add_argument("--chunk-batches", type=int, default=4,
+                    help="microbatches per flushed chunk ([P,CB,B] grid)")
+    ap.add_argument("--window", type=int, default=1,
+                    help="speculative window width (explicit; no auto on a live stream)")
+    ap.add_argument("--model", default="centroid")
+    ap.add_argument("--detector", default="ddm", choices=DETECTOR_NAMES)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP ingress port (0 = OS-assigned, see banner)")
+    ap.add_argument("--linger-s", type=float, default=0.25,
+                    help="max wait before a partial microbatch flushes short")
+    ap.add_argument("--heartbeat-s", type=float, default=10.0)
+    ap.add_argument("--data-policy", default="quarantine",
+                    choices=DATA_POLICIES,
+                    help="admission policy (serve default: quarantine; "
+                    "strict rejects rows per connection, repair imputes "
+                    "from running column means)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="run log + verdict sidecar + registry directory")
+    ap.add_argument("--checkpoint", default="",
+                    help="detector-state checkpoint path (enables resume)")
+    ap.add_argument("--checkpoint-every", type=int, default=1)
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="persistent XLA cache (restart warm-start)")
+    ap.add_argument("--no-shuffle", action="store_true",
+                    help="disable the stripe-time per-microbatch shuffle")
+    ap.add_argument("--max-chunks", type=int, default=None,
+                    help="drain after N published microbatches (CI/tests)")
+    args = ap.parse_args(argv)
+
+    cfg = RunConfig(
+        model=args.model,
+        detector=args.detector,
+        partitions=args.partitions,
+        per_batch=args.per_batch,
+        window=args.window,
+        seed=args.seed,
+        shuffle_batches=not args.no_shuffle,
+        data_policy=args.data_policy,
+        telemetry_dir=args.telemetry_dir,
+        compile_cache_dir=args.compile_cache_dir,
+        results_csv="",
+    )
+    params = ServeParams(
+        num_features=args.features,
+        num_classes=args.classes,
+        host=args.host,
+        port=args.port,
+        chunk_batches=args.chunk_batches,
+        linger_s=args.linger_s,
+        checkpoint=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+        heartbeat_s=args.heartbeat_s,
+    )
+    runner = ServeRunner(cfg, params, max_chunks=args.max_chunks)
+    banner = runner.start()
+    print(json.dumps(banner), flush=True)
+    # SIGTERM/SIGINT drain: flush in-flight batches, final atomic
+    # checkpoint, registry → completed — then exit 0 (the smoke gate's
+    # contract). A second signal falls through to the default handler.
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: runner.request_stop())
+    raise SystemExit(runner.serve_forever())
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

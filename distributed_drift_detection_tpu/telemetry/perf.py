@@ -77,6 +77,13 @@ CELLS = (
     ("soak_xl_value", _UP, True, "rows/s"),
     ("chunked_value", _UP, True, "rows/s"),
     ("chunked_overlap_efficiency", _UP, False, ""),
+    # Online-serving SLO (bench.py --serve, r07+): informational — the
+    # loopback daemon's latency moves with host load and the requested
+    # replay rate, which are invocation provenance, not code properties;
+    # the serve smoke/parity gates own correctness.
+    ("serve_rows_per_sec", _UP, False, "rows/s"),
+    ("serve_p50_ms", _DOWN, False, "ms"),
+    ("serve_p99_ms", _DOWN, False, "ms"),
     ("xla_flops", _DOWN, False, "flops"),
     ("xla_bytes_accessed", _DOWN, False, "B"),
     ("xla_temp_bytes", _DOWN, False, "B"),
@@ -199,6 +206,9 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "soak_xl_value",
         "chunked_value",
         "chunked_overlap_efficiency",
+        "serve_rows_per_sec",
+        "serve_p50_ms",
+        "serve_p99_ms",
         "mean_delay_batches",
         "detections",
     ):

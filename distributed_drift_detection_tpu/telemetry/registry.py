@@ -37,6 +37,18 @@ import time
 
 INDEX_NAME = "index.jsonl"
 
+# Row-record sidecar suffixes that live next to a run log but are not run
+# logs: quarantine sidecars (io.sanitize) and the serving daemon's verdict /
+# heartbeat sidecars (serve.runner). ``newest_run_log`` must never resolve
+# one — on a *live* serving directory the verdict sidecar is usually the
+# most recently appended ``*.jsonl``, and resolving it would hand ``report
+# --dir`` / ``watch <dir>`` a file that fails event-schema validation.
+SIDECAR_SUFFIXES = (
+    "quarantine.jsonl",
+    "verdicts.jsonl",
+    "heartbeat.jsonl",
+)
+
 # The only statuses the fold recognizes; producers writing anything else
 # fail loudly at append time, not at read time on another machine.
 STATUSES = ("running", "completed", "failed")
@@ -181,9 +193,10 @@ def newest_run_log(telemetry_dir: str) -> str | None:
         for p in glob.glob(os.path.join(telemetry_dir, "*.jsonl"))
         if os.path.basename(p) != INDEX_NAME
         and os.path.basename(p) not in registered
-        # quarantine sidecars (io.sanitize) live next to their run log
-        # but are row records, not event logs — never "the newest run"
-        and not os.path.basename(p).endswith("quarantine.jsonl")
+        # sidecars (quarantine rows, serve verdicts/heartbeats) live next
+        # to their run log but are row records, not event logs — never
+        # "the newest run", even while being actively appended to
+        and not os.path.basename(p).endswith(SIDECAR_SUFFIXES)
     ]
     best_unreg: "tuple[float, str] | None" = None
     if unregistered:
