@@ -751,11 +751,19 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         port=0,
         chunk_batches=4,
         linger_s=0.1,
+        # No SLO evaluator for a bench probe: nothing to alert, and its
+        # reader thread must not race the histogram reset below.
+        slo=("none",),
     )
     runner = ServeRunner(cfg, params)
     banner = runner.start()
     thread = threading.Thread(target=runner.serve_forever, daemon=True)
     thread.start()
+    from distributed_drift_detection_tpu.telemetry.trace import (
+        hist_quantile,
+        latency_histogram,
+    )
+
     lines = format_lines(X[:rows], y[:rows])
     # Warm-up replay: one full pipeline's worth of chunks through the wire
     # path, so the measured replay sees a steady-state daemon.
@@ -767,6 +775,15 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         verdicts=banner["verdicts"],
         timeout=300,
     )
+    # Reset the row-latency histogram between warm-up and measurement:
+    # the warm-up runs unpaced with backpressure, and its congested
+    # samples would otherwise ride the lifetime percentiles while the
+    # sidecar pair below covers only the measured replay. The pipeline
+    # is idle here (warm-up verdicts fully covered), no ops server is
+    # attached, and slo=("none",) above means no evaluator thread reads
+    # the histogram — the clear races nothing.
+    hist = latency_histogram(runner.metrics)
+    hist.values.clear()
     rep = run_loadgen(
         banner["host"],
         banner["port"],
@@ -777,6 +794,14 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         stop=True,
     )
     thread.join(timeout=120)
+    # Live-registry percentiles (telemetry.trace): the daemon's own
+    # serve_row_latency_seconds{stage="total"} histogram over the
+    # measured replay only (cleared post-warm-up above) — the same
+    # numbers the /metrics scrape and /statusz expose, recorded next to
+    # the loadgen's sidecar-derived pair so the artifact pins their
+    # agreement round over round.
+    reg_p50 = hist_quantile(hist, 0.5, stage="total")
+    reg_p99 = hist_quantile(hist, 0.99, stage="total")
     return {
         "serve_rows": rep["rows_sent"],
         "serve_rows_per_sec": rep["achieved_rows_per_sec"],
@@ -784,6 +809,12 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         "serve_p50_ms": rep["p50_ms"],
         "serve_p99_ms": rep["p99_ms"],
         "serve_mean_ms": rep["mean_ms"],
+        "serve_registry_p50_ms": (
+            None if reg_p50 is None else round(reg_p50 * 1000.0, 2)
+        ),
+        "serve_registry_p99_ms": (
+            None if reg_p99 is None else round(reg_p99 * 1000.0, 2)
+        ),
         "serve_detections": rep["detections"],
         "serve_verdicts": rep["verdicts"],
         "serve_timeout": rep["timeout"],

@@ -42,13 +42,14 @@ and checkpoints. ``loadgen`` replays an ``io/synth`` spec or CSV at a
 target rows/s (optionally with seeded dirty rows) and reports achieved
 rate + p50/p99 row→verdict latency as JSON.
 
-Six further subcommands work offline (no accelerator — ``doctor`` reads
+Seven further subcommands work offline (no accelerator — ``doctor`` reads
 the data, the rest just the artifacts; ``heal --execute`` is the one that
 runs experiments):
 
     python -m distributed_drift_detection_tpu report <run.jsonl | --dir DIR>
     python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
+    python -m distributed_drift_detection_tpu top <run.jsonl | DIR>... [--statusz URL]
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
     python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
     python -m distributed_drift_detection_tpu doctor CSV [CSV ...]
@@ -58,7 +59,10 @@ directory's newest run); ``perf`` diffs bench artifacts across rounds per
 cell and exits nonzero on gated regressions beyond a tolerance
 (telemetry.perf); ``watch`` live-tails a run log — progress/ETA from
 heartbeats, exit 3 past ``--stall-after`` (telemetry.watch, the
-scriptable health check); ``correlate`` merges a multi-host run's
+scriptable health check); ``top`` renders one refreshing dashboard
+over many runs — throughput, latency percentiles, drift/quarantine
+rates, active alerts — from tailed logs and/or serving daemons'
+``--ops-port`` ``/statusz`` endpoints (telemetry.top); ``correlate`` merges a multi-host run's
 per-process logs into one timeline with straggler diagnostics
 (telemetry.correlate); ``heal`` diffs a sweep spec against the
 registry's completed runs and emits — or ``--execute``s under the
@@ -81,6 +85,7 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
+    "       python -m distributed_drift_detection_tpu top DIR_OR_LOGS [--statusz URL]\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
     "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
     "       python -m distributed_drift_detection_tpu doctor CSV [CSV ...]"
@@ -120,6 +125,13 @@ def main(argv: list[str]) -> None:
         from .telemetry.watch import main as watch_main
 
         watch_main(argv[1:])
+        return
+    if argv and argv[0] == "top":
+        # jax-free: the live dashboard tails logs and scrapes /statusz
+        # wherever the artifacts or ops endpoints are reachable.
+        from .telemetry.top import main as top_main
+
+        top_main(argv[1:])
         return
     if argv and argv[0] == "correlate":
         # jax-free: multi-host logs are merged wherever they are mirrored.

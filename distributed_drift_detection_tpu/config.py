@@ -297,6 +297,24 @@ class ServeParams(NamedTuple):
     # Idle liveness: emit a heartbeat event at least this often even with
     # no traffic, so `watch --stall-after` can tell "idle" from "dead".
     heartbeat_s: float = 10.0
+    # --- ops plane (telemetry.ops / .slo / .trace) ---
+    # HTTP ops port (None = no ops server; 0 = OS-assigned, see banner):
+    # /metrics (live Prometheus text), /healthz (200 healthy / 503 while
+    # an SLO alert fires or the ingress poisoned the batcher), /statusz
+    # (JSON snapshot). Binds to `host`, like the ingress.
+    ops_port: "int | None" = None
+    # Declarative SLO rules, `kind=threshold` each (telemetry.slo
+    # RULE_KINDS: p99_ms, verdict_age_s, quarantine_pct, stall_s);
+    # ("none",) disables alerting. The default ships a stall alarm so an
+    # out-of-the-box daemon can tell "wedged" from "idle".
+    slo: tuple = ("stall_s=60",)
+    # Evaluator cadence (its own daemon thread — the serve loop being
+    # wedged is exactly what stall_s must catch).
+    slo_interval_s: float = 1.0
+    # Crash flight recorder: ring capacity in events. On an unhandled
+    # exception the last N run-log events dump to
+    # `<run-log>.flightrec.jsonl`; a clean drain leaves no dump. 0 = off.
+    flightrec_events: int = 256
 
 
 @dataclasses.dataclass(frozen=True)

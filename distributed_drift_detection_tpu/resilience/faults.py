@@ -46,7 +46,10 @@ Sites (each named where the production code calls :func:`fire`):
                        the daemon after the chunk's state advanced but
                        before its verdict/checkpoint landed (the
                        kill-and-resume shape); ``kind='torn_write'``
-                       tears the verdict sidecar's trailing line
+                       tears the verdict sidecar's trailing line;
+                       ``kind='stall',seconds=S`` genuinely sleeps the
+                       serve loop for S seconds (the wedge the SLO
+                       ``stall_s`` rule and ops ``/healthz`` must catch)
 =====================  ====================================================
 
 Arming is explicit (:func:`arm` in-process, or the ``DDD_FAULTS`` env var
@@ -82,7 +85,10 @@ class InjectedTimeout(InjectedFault):
 
 ENV_VAR = "DDD_FAULTS"
 
-KINDS = ("raise", "timeout", "torn_write", "nan_cell", "bad_label", "ragged_row")
+KINDS = (
+    "raise", "timeout", "stall", "torn_write",
+    "nan_cell", "bad_label", "ragged_row",
+)
 
 # Data-corruption kinds: instead of raising, a firing mutates the CSV text
 # lines the ``stream.load`` site hands in — ``times`` is reinterpreted as
@@ -122,6 +128,12 @@ class FaultSpec:
     kind: str = "raise"
     rate: float = 0.0
     seed: int = 0
+    # kind='stall' only: how long the firing site really sleeps. Unlike
+    # 'timeout' (which *stands in* for a blown budget by raising
+    # immediately), a stall genuinely wedges the calling thread — the
+    # shape the serving SLO engine's `stall_s` rule and the watch CLI's
+    # stall contract exist to detect.
+    seconds: float = 5.0
     hits: int = 0  # invocations of the site seen since arming
     fired: int = 0  # faults actually raised
 
@@ -159,6 +171,7 @@ def arm(
     kind: str = "raise",
     rate: float = 0.0,
     seed: int = 0,
+    seconds: float = 5.0,
 ) -> FaultSpec:
     """Arm ``site``; returns the live spec (its counters update as the
     site is hit). Re-arming a site replaces its spec and resets counters.
@@ -185,7 +198,10 @@ def arm(
         raise ValueError("at/times must be >= 0")
     if at == 0 and rate == 0.0:
         raise ValueError("arm needs a positional `at` or a Bernoulli `rate`")
-    spec = FaultSpec(site=site, at=at, times=times, kind=kind, rate=rate, seed=seed)
+    spec = FaultSpec(
+        site=site, at=at, times=times, kind=kind, rate=rate, seed=seed,
+        seconds=float(seconds),
+    )
     _ARMED[site] = spec
     return spec
 
@@ -222,7 +238,7 @@ def arm_from_env(spec: str | None = None) -> list[str]:
             key, _, val = pair.partition("=")
             if key in ("at", "times", "seed"):
                 kw[key] = int(val)
-            elif key == "rate":
+            elif key in ("rate", "seconds"):
                 kw[key] = float(val)
             elif key == "kind":
                 kw[key] = val
@@ -328,6 +344,14 @@ def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = N
                 seed=spec.seed,
                 label_col=label_col,
             )
+        return
+    if spec.kind == "stall":
+        # A real wedge, not a raise: the site's thread sleeps and then
+        # continues normally — observable only by the staleness it causes
+        # (SLO `stall_s`, `watch --stall-after`), exactly as in the field.
+        import time as _time
+
+        _time.sleep(max(spec.seconds, 0.0))
         return
     detail = f"injected fault at {site!r} (hit {spec.hits})"
     if context:

@@ -55,7 +55,11 @@ class SealedChunk(NamedTuple):
     accounting meta (``chunk`` index, ``start_row`` grid position,
     ``rows`` admitted into it, ``rows_through`` cumulative admitted rows
     up to and including it — the loadgen's latency-attribution key —
-    ``short`` flag and seal wall-clock)."""
+    ``short`` flag and seal wall-clock). For end-to-end row tracing
+    (``telemetry.trace``) the meta also carries ``ingest_mono`` — one
+    monotonic admission stamp per admitted row, in stream order — and
+    ``sealed_mono``, the seal instant on the same clock; the serve loop
+    turns these into the live ``serve_row_latency_seconds`` stages."""
 
     chunk: object  # engine.loop.Batches
     meta: dict
@@ -98,6 +102,7 @@ class MicroBatcher:
         self._X: list[np.ndarray] = []
         self._y: list[np.ndarray] = []
         self._ok: list["np.ndarray | None"] = []
+        self._ts: list[np.ndarray] = []  # per-row monotonic ingest stamps
         self._buffered = 0
         self._first_ts: "float | None" = None  # monotonic, oldest buffered row
         self._queue: list[SealedChunk] = []
@@ -115,6 +120,11 @@ class MicroBatcher:
         y = np.ascontiguousarray(y, np.int32)
         if len(X) == 0:
             return
+        # One ingest stamp per block (rows of one push arrived together),
+        # taken BEFORE the backpressure wait below: under overload that
+        # wait IS the latency a client experiences, and a post-wait stamp
+        # would hide exactly the congestion the p99 SLO exists to catch.
+        ingest_mono = time.monotonic()
         with self._cv:
             while len(self._queue) >= self._max_queue and self._error is None:
                 self._cv.wait(0.1)
@@ -123,6 +133,7 @@ class MicroBatcher:
             self._X.append(X)
             self._y.append(y)
             self._ok.append(None if ok is None else np.asarray(ok, bool))
+            self._ts.append(np.full(len(X), ingest_mono, dtype=np.float64))
             self._buffered += len(X)
             self.rows_admitted += len(X)
             if self._first_ts is None:
@@ -147,6 +158,21 @@ class MicroBatcher:
     def empty(self) -> bool:
         with self._cv:
             return not self._queue and not self._buffered
+
+    def poisoned(self) -> "BaseException | None":
+        """The producer-side failure carried to the consumer, if any
+        (ops-plane health surface; read-only)."""
+        with self._cv:
+            return self._error
+
+    def depth(self) -> dict:
+        """Queue occupancy for ``/statusz``: sealed chunks waiting for
+        the serve loop + rows buffered toward the next seal."""
+        with self._cv:
+            return {
+                "queued_chunks": len(self._queue),
+                "buffered_rows": self._buffered,
+            }
 
     def get(self, timeout: float = 0.0) -> "SealedChunk | None":
         """Next sealed chunk, sealing a lingering partial when its
@@ -179,6 +205,7 @@ class MicroBatcher:
     def _seal_locked(self, n_take: int) -> None:
         X = np.concatenate(self._X) if len(self._X) > 1 else self._X[0]
         y = np.concatenate(self._y) if len(self._y) > 1 else self._y[0]
+        ts = np.concatenate(self._ts) if len(self._ts) > 1 else self._ts[0]
         ok = None
         if any(o is not None for o in self._ok):
             ok = np.concatenate(
@@ -189,6 +216,7 @@ class MicroBatcher:
             )
         take_X, rest_X = X[:n_take], X[n_take:]
         take_y, rest_y = y[:n_take], y[n_take:]
+        take_ts, rest_ts = ts[:n_take], ts[n_take:]
         take_ok = rest_ok = None
         if ok is not None:
             take_ok, rest_ok = ok[:n_take], ok[n_take:]
@@ -212,6 +240,10 @@ class MicroBatcher:
             "rows_through": int(taken_before + n_take),
             "short": n_take < self.rows_per_chunk,
             "sealed_ts": time.time(),
+            # row-tracing stamps (telemetry.trace.observe_chunk_stages);
+            # never serialized — _publish copies named scalars only
+            "ingest_mono": take_ts,
+            "sealed_mono": time.monotonic(),
         }
         self._queue.append(SealedChunk(chunk, meta))
         # Grid-slot semantics: the stream position always advances by the
@@ -225,6 +257,7 @@ class MicroBatcher:
         self._ok = [rest_ok] if len(rest_X) and rest_ok is not None else (
             [None] if len(rest_X) else []
         )
+        self._ts = [rest_ts] if len(rest_X) else []
         self._buffered = len(rest_X)
         self._first_ts = time.monotonic() if self._buffered else None
 

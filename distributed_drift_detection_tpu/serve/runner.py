@@ -29,6 +29,19 @@ One :class:`ServeRunner` owns the whole lifecycle:
   ingress, flushes the partial microbatch through the validity plane,
   publishes everything in flight, writes an atomic final checkpoint, and
   flips the registry record to ``completed``.
+* **ops plane** (``--ops-port``, telemetry.ops/.slo/.trace) — a threaded
+  HTTP server exposes the **live** metrics registry (``/metrics``,
+  byte-identical to the ``.prom`` exporter), a drain/poison/stall-aware
+  health check (``/healthz``: 200 healthy or draining, 503 while an SLO
+  alert fires or the ingress poisoned the batcher) and a JSON
+  ``/statusz`` snapshot. Every published microbatch feeds the
+  ``serve_row_latency_seconds{stage=...}`` histograms from the admission
+  layer's per-row monotonic ingest stamps (admission/queue/device/
+  collect/total), so live p50/p99 row→verdict latency needs no post-hoc
+  sidecar tailing. A background SLO evaluator turns declarative rules
+  (``--slo p99_ms=250`` ...) into schema-v1 ``alert`` events, and a
+  bounded flight recorder dumps the last N events to
+  ``<run-log>.flightrec.jsonl`` on a crash — never on a clean drain.
 
 The ``serve.flush`` fault site fires at verdict publication —
 ``kind='raise'`` kills the daemon after a chunk's state advanced but
@@ -145,6 +158,16 @@ class ServeRunner:
         self._ingress = None
         self._log = None
         self._metrics = None
+        self._lat_hist = None
+        self._ops = None
+        self._slo = None
+        self._slo_stop = None
+        self._slo_thread = None
+        self._recorder = None
+        self._compile_info: dict = {}
+        self._last_pub_mono: "float | None" = None
+        self._loop_mono: "float | None" = None  # serve-loop liveness stamp
+        self._inflight_n = 0
         self._verdict_fh = None
         self.verdicts_path: "str | None" = None
         self._flag_base = 0  # flag columns published == batches published
@@ -169,14 +192,24 @@ class ServeRunner:
         returns the startup banner (host/port/artifact paths)."""
         from ..api import prepare_chunked
         from ..io.stream import stripe_chunk
+        from ..telemetry import trace
+        from ..telemetry.metrics import MetricsRegistry
+        from ..telemetry.ops import FlightRecorder
+        from ..telemetry.slo import SloEngine, parse_rules, start_evaluator
 
         cfg, params = self.cfg, self.params
         self._t_start = time.monotonic()
+        # The registry is live regardless of telemetry persistence: the
+        # ops plane scrapes it over HTTP; write_exports at drain still
+        # requires a telemetry dir.
+        self._metrics = MetricsRegistry()
+        self._lat_hist = trace.latency_histogram(self._metrics)
+        if params.flightrec_events > 0:
+            self._recorder = FlightRecorder(params.flightrec_events)
         ident = None
         if cfg.telemetry_dir:
             from ..parallel.multihost import host_identity
             from ..telemetry.events import EventLog
-            from ..telemetry.metrics import MetricsRegistry
 
             ident = host_identity()
             self._log = EventLog.open_run(
@@ -184,7 +217,8 @@ class ServeRunner:
                 name=cfg.resolved_app_name(),
                 process_index=ident["process_index"],
             )
-            self._metrics = MetricsRegistry()
+            if self._recorder is not None:
+                self._log.tap = self._recorder.record
         stem = (
             os.path.splitext(self._log.path)[0]
             if self._log is not None
@@ -198,6 +232,7 @@ class ServeRunner:
             params.num_classes,
             chunk_batches=params.chunk_batches,
         )
+        self._compile_info = dict(compile_info)
         resume = None
         if params.checkpoint and os.path.exists(params.checkpoint):
             example = stripe_chunk(
@@ -294,10 +329,33 @@ class ServeRunner:
                 self.request_stop,
             )
             self._ingress.start()
+        # SLO engine + evaluator thread: the judge must not live on the
+        # serve loop — the loop being wedged is what stall_s detects.
+        rules = parse_rules(params.slo)
+        self._slo = SloEngine(rules)
+        if rules:
+            self._slo_thread, self._slo_stop = start_evaluator(
+                self._slo,
+                self._slo_snapshot,
+                self._log.emit if self._log is not None else None,
+                params.slo_interval_s,
+            )
+        if params.ops_port is not None:
+            from ..telemetry.ops import OpsServer
+
+            self._ops = OpsServer(
+                params.host,
+                params.ops_port,
+                metrics_fn=self._metrics.to_prometheus_text,
+                health_fn=self._health,
+                status_fn=self._statusz,
+            )
+            self._ops.start()
         return {
             "serving": True,
             "host": params.host,
             "port": self._ingress.port if self._ingress is not None else None,
+            "ops_port": self._ops.port if self._ops is not None else None,
             "pid": os.getpid(),
             "run_log": self._log.path if self._log is not None else None,
             "verdicts": self.verdicts_path,
@@ -309,6 +367,116 @@ class ServeRunner:
         """Graceful drain (signal handlers and the STOP line land here).
         Thread-safe and idempotent; the serve loop performs the drain."""
         self._stop.set()
+
+    # -- ops-plane surface (read-only; served from the ops/evaluator
+    # -- threads, so everything here reads GIL-atomic scalars or takes the
+    # -- owning structure's lock) ---------------------------------------------
+
+    @property
+    def metrics(self):
+        """The live registry (ops scrape target; bench reads quantiles)."""
+        return self._metrics
+
+    def _slo_snapshot(self) -> dict:
+        """Rule kind → current value (None = not measurable right now)."""
+        from ..telemetry.trace import hist_quantile
+
+        now = time.monotonic()
+        p99 = hist_quantile(self._lat_hist, 0.99, stage="total")
+        verdict_age = None
+        if self._last_pub_mono is not None and (
+            self.batcher is not None
+            and self.batcher.rows_admitted > self._rows_published
+        ):
+            # Output staleness only means anything while work is pending:
+            # an idle daemon's last verdict ages by design.
+            verdict_age = now - self._last_pub_mono
+        quarantine_pct = None
+        adm = self.admission
+        if adm is not None and adm.rows_seen > 0:
+            quarantine_pct = 100.0 * adm.rows_quarantined / adm.rows_seen
+        # Loop liveness, not event age: works without a run log too (an
+        # ops-only daemon must still tell wedged from idle), and any
+        # wedge — device sync, publish, emit — blocks the loop thread.
+        stall = None if self._loop_mono is None else now - self._loop_mono
+        return {
+            "p99_ms": None if p99 is None else p99 * 1000.0,
+            "verdict_age_s": verdict_age,
+            "quarantine_pct": quarantine_pct,
+            "stall_s": stall,
+        }
+
+    def _health(self) -> "tuple[int, dict]":
+        """The ``/healthz`` contract: (HTTP status, JSON payload)."""
+        alerts = self._slo.active() if self._slo is not None else []
+        poisoned = (
+            self.batcher.poisoned() if self.batcher is not None else None
+        )
+        healthy = not alerts and poisoned is None
+        payload = {
+            "status": (
+                ("draining" if self._stop.is_set() else "serving")
+                if healthy
+                else "degraded"
+            ),
+            "run_id": self._log.run_id if self._log is not None else None,
+            "alerts": alerts,
+            "poisoned": None if poisoned is None else repr(poisoned),
+        }
+        return (200 if healthy else 503), payload
+
+    def _statusz(self) -> dict:
+        """The ``/statusz`` snapshot (one JSON dict, cheap to assemble)."""
+        from ..telemetry.trace import hist_quantile
+
+        now = time.monotonic()
+        adm, batcher = self.admission, self.batcher
+        p50 = hist_quantile(self._lat_hist, 0.5, stage="total")
+        p99 = hist_quantile(self._lat_hist, 0.99, stage="total")
+        return {
+            "run_id": self._log.run_id if self._log is not None else None,
+            "pid": os.getpid(),
+            "uptime_s": (
+                round(now - self._t_start, 3)
+                if self._t_start is not None
+                else None
+            ),
+            "draining": self._stop.is_set(),
+            "rows": {
+                "ingress_seen": adm.rows_seen if adm is not None else 0,
+                "admitted": (
+                    batcher.rows_admitted if batcher is not None else 0
+                ),
+                "published": self._rows_published,
+                "quarantined": (
+                    adm.rows_quarantined if adm is not None else 0
+                ),
+                "rejected": adm.rows_rejected if adm is not None else 0,
+                "repaired": adm.rows_repaired if adm is not None else 0,
+            },
+            "chunks": {
+                "published": self._published,
+                "inflight": self._inflight_n,
+                **(batcher.depth() if batcher is not None else {}),
+            },
+            "detections": self._detections,
+            "last_verdict_age_s": (
+                None
+                if self._last_pub_mono is None
+                else round(now - self._last_pub_mono, 3)
+            ),
+            "latency_ms": {
+                "p50": None if p50 is None else round(p50 * 1000.0, 3),
+                "p99": None if p99 is None else round(p99 * 1000.0, 3),
+            },
+            "compile": {
+                **self._compile_info,
+                "compile_cache_dir": self.cfg.compile_cache_dir or None,
+            },
+            "checkpoint": self.params.checkpoint or None,
+            "resumed": self.resumed_meta is not None,
+            "alerts": self._slo.active() if self._slo is not None else [],
+        }
 
     # -- the loop ------------------------------------------------------------
 
@@ -324,6 +492,7 @@ class ServeRunner:
         stop_handled = False
         try:
             while True:
+                self._loop_mono = time.monotonic()  # SLO stall_s stamp
                 if self._stop.is_set() and not stop_handled:
                     stop_handled = True
                     if self._ingress is not None:
@@ -332,9 +501,14 @@ class ServeRunner:
                 item = self.batcher.get(0.0 if inflight else params.poll_s)
                 if item is not None:
                     flags = self.det.feed(self.det.place(item.chunk))
+                    # Row-tracing stamp: the chunk entered the device
+                    # pipeline (queue stage ends, device stage begins).
+                    item.meta["fed_mono"] = time.monotonic()
                     inflight.append((flags, item.meta))
+                self._inflight_n = len(inflight)
                 if inflight and (item is None or len(inflight) >= self._depth):
                     self._publish(*inflight.pop(0))
+                    self._inflight_n = len(inflight)
                     if (
                         params.checkpoint
                         and self._published - self._ckpt_at
@@ -347,6 +521,7 @@ class ServeRunner:
                         # depth 1 makes this a no-op).
                         while inflight:
                             self._publish(*inflight.pop(0))
+                            self._inflight_n = len(inflight)
                         self._save_checkpoint()
                         self._ckpt_at = self._published
                 if (
@@ -374,6 +549,7 @@ class ServeRunner:
         import jax
 
         host = jax.tree.map(np.asarray, flags)
+        collected_mono = time.monotonic()  # device stage ends here
         cg = np.asarray(host.change_global)
         changed = cg >= 0
         changes = [
@@ -406,6 +582,18 @@ class ServeRunner:
         )
         self._verdict_fh.write(line + "\n")
         self._verdict_fh.flush()
+        published_mono = time.monotonic()
+        if self._lat_hist is not None:
+            from ..telemetry.trace import observe_chunk_stages
+
+            observe_chunk_stages(
+                self._lat_hist,
+                meta,
+                fed_mono=meta.get("fed_mono", collected_mono),
+                collected_mono=collected_mono,
+                published_mono=published_mono,
+            )
+        self._last_pub_mono = published_mono
         self._flag_base += int(cg.shape[1])
         self._published += 1
         self._rows_published = int(meta["rows_through"])
@@ -447,7 +635,26 @@ class ServeRunner:
             },
         )
 
+    def _stop_ops(self) -> None:
+        """Tear down the ops plane (idempotent; both exit paths)."""
+        if self._slo_stop is not None:
+            self._slo_stop.set()
+            self._slo_stop = None
+        if self._slo_thread is not None:
+            # Join before the final events land: a mid-evaluate alert
+            # must not serialize AFTER run_completed ("last event" is a
+            # schema contract) or race the log close.
+            self._slo_thread.join(timeout=5)
+            self._slo_thread = None
+        if self._ops is not None:
+            try:
+                self._ops.stop()
+            except Exception:
+                pass
+            self._ops = None
+
     def _finish(self) -> None:
+        self._stop_ops()
         if self.params.checkpoint and self.det.carry is not None:
             self._save_checkpoint()
         elapsed = time.monotonic() - self._t_start
@@ -479,6 +686,7 @@ class ServeRunner:
         self._close_files()
 
     def _fail(self) -> None:
+        self._stop_ops()
         try:
             if self._ingress is not None:
                 self._ingress.stop()
@@ -493,6 +701,15 @@ class ServeRunner:
                 )
             except Exception:
                 pass  # best-effort crash evidence (api.run's posture)
+            # Crash flight recorder: the last N events land next to the
+            # log (dump() is best-effort — it must not mask the original
+            # failure). A clean drain never writes this file.
+            if self._recorder is not None:
+                from ..telemetry.ops import FLIGHTREC_SUFFIX
+
+                self._recorder.dump(
+                    os.path.splitext(self._log.path)[0] + FLIGHTREC_SUFFIX
+                )
             self._log.close()
         self._close_files()
 
@@ -563,7 +780,29 @@ def main(argv=None) -> None:
                     help="disable the stripe-time per-microbatch shuffle")
     ap.add_argument("--max-chunks", type=int, default=None,
                     help="drain after N published microbatches (CI/tests)")
+    ap.add_argument("--ops-port", type=int, default=None,
+                    help="HTTP ops plane: /metrics, /healthz, /statusz "
+                    "(0 = OS-assigned, see banner; omit = no ops server)")
+    ap.add_argument("--slo", action="append", default=None,
+                    metavar="KIND=THRESHOLD",
+                    help="SLO alert rule (p99_ms|verdict_age_s|"
+                    "quarantine_pct|stall_s), repeatable; 'none' disables. "
+                    "Default: stall_s=60")
+    ap.add_argument("--slo-interval-s", type=float, default=1.0,
+                    help="SLO evaluator cadence (its own thread)")
+    ap.add_argument("--flightrec-events", type=int, default=256,
+                    help="crash flight-recorder ring capacity (0 = off)")
     args = ap.parse_args(argv)
+
+    # CLI-driven fault arming (DDD_FAULTS, the grid harness's pattern):
+    # inert unless the env var is set. The ops-smoke CI job wedges the
+    # serve loop with a serve.flush kind=stall this way and asserts the
+    # SLO stall alert + /healthz flip without writing Python.
+    armed = faults.arm_from_env()
+    if armed:
+        print(
+            json.dumps({"armed_faults": armed}), file=sys.stderr, flush=True
+        )
 
     cfg = RunConfig(
         model=args.model,
@@ -588,6 +827,10 @@ def main(argv=None) -> None:
         checkpoint=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         heartbeat_s=args.heartbeat_s,
+        ops_port=args.ops_port,
+        slo=tuple(args.slo) if args.slo else ServeParams._field_defaults["slo"],
+        slo_interval_s=args.slo_interval_s,
+        flightrec_events=args.flightrec_events,
     )
     runner = ServeRunner(cfg, params, max_chunks=args.max_chunks)
     banner = runner.start()

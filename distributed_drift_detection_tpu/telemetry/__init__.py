@@ -35,6 +35,21 @@ subsystem persists that answer as artifacts instead:
   progress/ETA from ``heartbeat`` events, exit 3 when stalled past
   ``--stall-after`` — the scriptable health check for CI and pod
   launchers.
+* :mod:`.ops` — the serving daemon's live HTTP ops plane
+  (``--ops-port``): ``/metrics`` (the registry, byte-identical to the
+  ``.prom`` exporter), ``/healthz`` (200/503 by SLO/poison state),
+  ``/statusz`` (JSON snapshot) + the crash flight recorder
+  (``<run>.flightrec.jsonl``).
+* :mod:`.trace` — end-to-end row tracing: the
+  ``serve_row_latency_seconds{stage=…}`` live histograms (vectorized
+  per-row observe) and histogram-quantile helpers for both the live
+  registry and parsed scrapes.
+* :mod:`.slo` — declarative SLO rules (p99 latency, verdict staleness,
+  quarantine rate, event stall) evaluated on a cadence; threshold
+  crossings emit schema-v1 ``alert`` events and drive ``/healthz``.
+* :mod:`.top` — ``python -m distributed_drift_detection_tpu top``: one
+  refreshing terminal dashboard over many runs, from tailed logs and/or
+  ``/statusz`` endpoints.
 
 Telemetry is **off by default** (``RunConfig.telemetry_dir=None``): every
 hook is an ``if log is not None`` guard outside the timed span, so the

@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 
 import numpy as np
@@ -87,6 +88,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # run's Final Time span — into its own per-supervision log; the
     # failed attempt's own run log + registry record carry the evidence.
     "run_retried": ("attempt", "max_attempts", "reason", "backoff_s"),
+    # SLO alert transition (telemetry.slo, serving daemon): ``rule`` (one
+    # of slo.RULE_KINDS) crossed into ("firing") or out of ("resolved")
+    # violation; ``value`` is the measured quantity at the transition,
+    # ``threshold`` the rule's bar. Emitted by the daemon's evaluator
+    # thread — the serve path only, strictly outside any api.run Final
+    # Time span (purity holds by construction).
+    "alert": ("rule", "state", "value", "threshold"),
     # one per run log, last event: totals over the reference's Final Time
     "run_completed": ("rows", "seconds", "detections"),
 }
@@ -160,6 +168,14 @@ class EventLog:
         self._clock = clock
         self._seq = 0
         self._fh = open(path, "a")
+        # Emission is serialized: the serving daemon's SLO evaluator
+        # thread emits alerts into the same log as the serve loop, and an
+        # interleaved seq/write would corrupt the artifact.
+        self._lock = threading.Lock()
+        # Optional per-event observer (e.g. the ops plane's
+        # FlightRecorder): called with each validated record after it is
+        # flushed, under the same lock (ring order == log order).
+        self.tap = None
 
     @classmethod
     def open_run(
@@ -194,23 +210,28 @@ class EventLog:
 
     def emit(self, etype: str, **fields) -> dict:
         """Validate and append one event; returns the full record."""
-        event = {
-            "v": SCHEMA_VERSION,
-            "type": etype,
-            "ts": self._clock(),
-            "seq": self._seq,
-            **fields,
-        }
-        validate_event(event)
-        payload = json.dumps(event)
-        # Fault-injection site (resilience.faults, no-op unless armed):
-        # kind='torn_write' appends a partial prefix of this payload with
-        # no newline and raises — the exact torn-tail artifact the
-        # allow_partial_tail read path and crash tests exercise.
-        faults.fire("telemetry.emit", fh=self._fh, payload=payload, seq=self._seq)
-        self._fh.write(payload + "\n")
-        self._fh.flush()
-        self._seq += 1
+        with self._lock:
+            event = {
+                "v": SCHEMA_VERSION,
+                "type": etype,
+                "ts": self._clock(),
+                "seq": self._seq,
+                **fields,
+            }
+            validate_event(event)
+            payload = json.dumps(event)
+            # Fault-injection site (resilience.faults, no-op unless armed):
+            # kind='torn_write' appends a partial prefix of this payload with
+            # no newline and raises — the exact torn-tail artifact the
+            # allow_partial_tail read path and crash tests exercise.
+            faults.fire(
+                "telemetry.emit", fh=self._fh, payload=payload, seq=self._seq
+            )
+            self._fh.write(payload + "\n")
+            self._fh.flush()
+            self._seq += 1
+            if self.tap is not None:
+                self.tap(event)
         return event
 
     def close(self) -> None:

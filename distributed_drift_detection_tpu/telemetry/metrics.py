@@ -160,10 +160,14 @@ class MetricsRegistry:
     def to_json(self) -> dict:
         """Stable dict form: metric name -> kind/help/samples."""
         out = {}
-        for name in sorted(self._metrics):
+        # .copy(): exporters may run on a scraping thread (the serving
+        # daemon's ops plane) while producers insert first-use metrics —
+        # a dict snapshot keeps iteration safe; per-sample reads are
+        # GIL-atomic enough for a monitoring scrape.
+        for name in sorted(self._metrics.copy()):
             m = self._metrics[name]
             samples = []
-            for key in sorted(m.values):
+            for key in sorted(m.values.copy()):
                 labels = dict(key)
                 if m.kind == "histogram":
                     _, total_sum, count = m.values[key]
@@ -185,14 +189,15 @@ class MetricsRegistry:
         return out
 
     def to_prometheus_text(self) -> str:
-        """Prometheus text exposition format, deterministically ordered."""
+        """Prometheus text exposition format, deterministically ordered.
+        Safe to call from a scraping thread (see :meth:`to_json`)."""
         lines = []
-        for name in sorted(self._metrics):
+        for name in sorted(self._metrics.copy()):
             m = self._metrics[name]
             if m.help:
                 lines.append(f"# HELP {name} {_escape(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
-            for key in sorted(m.values):
+            for key in sorted(m.values.copy()):
                 if m.kind == "histogram":
                     _, total_sum, count = m.values[key]
                     for le, c in m.cumulative(key):

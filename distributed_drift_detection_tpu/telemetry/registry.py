@@ -47,6 +47,7 @@ SIDECAR_SUFFIXES = (
     "quarantine.jsonl",
     "verdicts.jsonl",
     "heartbeat.jsonl",
+    "flightrec.jsonl",
 )
 
 # The only statuses the fold recognizes; producers writing anything else

@@ -44,6 +44,7 @@ def summarize(events: list[dict]) -> dict:
         "chunks": [],
         "legs": [],
         "retried": [],
+        "alerts": [],
         "quarantine": None,
         "heartbeat": None,
         "completed": None,
@@ -73,6 +74,8 @@ def summarize(events: list[dict]) -> dict:
             s["legs"].append(e)
         elif t == "run_retried":
             s["retried"].append(e)
+        elif t == "alert":
+            s["alerts"].append(e)
         elif t == "rows_quarantined":
             s["quarantine"] = e
         elif t == "heartbeat":
@@ -283,6 +286,24 @@ def render_report(events: list[dict]) -> str:
             f"retrains   {s['retrains']}  ({s['forced_retrains']} forced "
             "by the saturation guard)"
         )
+    if s["alerts"]:
+        # SLO alert trail (telemetry.slo, serving runs): every crossing,
+        # in order, plus whatever is still firing at the log's end.
+        firing: dict[str, dict] = {}
+        for a in s["alerts"]:
+            if a["state"] == "firing":
+                firing[a["rule"]] = a
+            else:
+                firing.pop(a["rule"], None)
+        trail = ", ".join(
+            f"{a['rule']} {a['state']} at {a['value']:.4g} (>{a['threshold']:g})"
+            for a in s["alerts"]
+        )
+        out.append(f"alerts     {len(s['alerts'])} transition(s): {trail}")
+        if firing:
+            out.append(
+                "           STILL FIRING: " + ", ".join(sorted(firing))
+            )
     if s["retried"]:
         # Supervisor retry trail (resilience.supervisor): how many
         # attempts were re-run and why the last one failed — the healed

@@ -1,0 +1,188 @@
+"""Declarative SLO rules + the serving daemon's alerting engine.
+
+A run log records what happened; nothing so far ever *judged* it. This
+module closes that gap for the online path: a small set of declarative
+rules is evaluated on a cadence against a live snapshot of the daemon,
+and every threshold crossing emits a schema-v1 ``alert`` event
+(``state="firing"`` / ``"resolved"``) into the run log — so alerts are
+ordinary, durable, torn-tail-tolerant telemetry that ``report``/``top``
+render and the ops plane's ``/healthz`` surfaces as a status code.
+
+Rule kinds (one rule per kind; the snapshot supplies the value under the
+same key, ``None`` = not currently measurable, rule skipped):
+
+=====================  ====================================================
+``p99_ms``             live p99 of ``serve_row_latency_seconds{stage=
+                       "total"}`` (``telemetry.trace.hist_quantile``), ms
+``verdict_age_s``      seconds since the last verdict was published —
+                       staleness of the daemon's *output*
+``quarantine_pct``     100 · quarantined / ingress rows seen — dirty-
+                       traffic share at admission
+``stall_s``            seconds since the serve loop last completed an
+                       iteration (its in-process liveness stamp — works
+                       with or without a run log; heartbeat events are
+                       the durable trace of the same signal) — the
+                       in-process twin of ``watch --stall-after``: a
+                       firing means the loop itself is wedged
+=====================  ====================================================
+
+The evaluator runs on its own daemon thread (:func:`start_evaluator`):
+the serve loop's blocking points (device sync, an injected
+``serve.flush`` stall) are exactly what ``stall_s`` must detect, so the
+judge cannot live on the thread being judged. ``EventLog.emit`` is
+thread-safe (internal lock), and the evaluator only ever *reads* runner
+state — it owns no locks of its own. Alerts are emitted strictly outside
+any ``api.run`` Final Time span (the evaluator exists only in the serve
+daemon; the purity tests are untouched by construction).
+
+No jax, stdlib only — importable by the jax-free CLIs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, NamedTuple
+
+RULE_KINDS = ("p99_ms", "verdict_age_s", "quarantine_pct", "stall_s")
+
+
+class SloRule(NamedTuple):
+    """One declarative rule: fire while ``value > threshold``."""
+
+    kind: str
+    threshold: float
+
+
+def parse_rules(specs) -> tuple[SloRule, ...]:
+    """Parse ``kind=threshold`` strings (the ``--slo`` CLI grammar) into
+    rules; unknown kinds and unparseable thresholds fail loudly. The
+    single spec ``none`` (or ``off``) disables alerting entirely."""
+    rules: list[SloRule] = []
+    specs = list(specs)
+    if [s.strip().lower() for s in specs] in (["none"], ["off"]):
+        return ()
+    for spec in specs:
+        kind, sep, value = spec.partition("=")
+        kind = kind.strip()
+        if not sep or kind not in RULE_KINDS:
+            raise ValueError(
+                f"bad SLO rule {spec!r}; expected kind=threshold with kind "
+                f"one of {RULE_KINDS} (or the single spec 'none')"
+            )
+        try:
+            threshold = float(value)
+        except ValueError:
+            raise ValueError(
+                f"bad SLO threshold in {spec!r}: {value!r} is not a number"
+            ) from None
+        if any(r.kind == kind for r in rules):
+            # One rule per kind is the engine's state-machine contract:
+            # two thresholds on one kind would fire/resolve against each
+            # other every evaluator tick, flooding the log with alerts.
+            raise ValueError(f"duplicate SLO rule kind {kind!r}")
+        rules.append(SloRule(kind, threshold))
+    return tuple(rules)
+
+
+class SloEngine:
+    """Threshold-crossing state machine over the rule set.
+
+    :meth:`evaluate` is called with a snapshot dict (rule kind → current
+    value or ``None``); each crossing INTO violation emits one
+    ``firing`` transition, each crossing back OUT one ``resolved`` —
+    never a re-fire per cadence tick. :meth:`active` lists the currently
+    firing alerts (the ``/healthz`` and ``/statusz`` surface).
+    """
+
+    def __init__(self, rules: "tuple[SloRule, ...]"):
+        self.rules = tuple(rules)
+        self._firing: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def evaluate(self, snapshot: dict, emit=None) -> list[dict]:
+        """One cadence tick; returns the transitions (also handed, one by
+        one, to ``emit(etype, **fields)`` — an ``EventLog.emit``-shaped
+        callable — when given)."""
+        transitions: list[dict] = []
+        with self._lock:
+            for rule in self.rules:
+                value = snapshot.get(rule.kind)
+                if value is None:
+                    continue
+                value = float(value)
+                firing = value > rule.threshold
+                was = rule.kind in self._firing
+                if firing and not was:
+                    rec = {
+                        "rule": rule.kind,
+                        "state": "firing",
+                        "value": value,
+                        "threshold": rule.threshold,
+                    }
+                    self._firing[rule.kind] = rec
+                    transitions.append(rec)
+                elif firing and was:
+                    # keep the surfaced value current for /statusz
+                    self._firing[rule.kind]["value"] = value
+                elif not firing and was:
+                    del self._firing[rule.kind]
+                    transitions.append(
+                        {
+                            "rule": rule.kind,
+                            "state": "resolved",
+                            "value": value,
+                            "threshold": rule.threshold,
+                        }
+                    )
+        if emit is not None:
+            for i, t in enumerate(transitions):
+                try:
+                    emit("alert", **t)
+                except Exception:
+                    # The log refused the event (full disk, closed file):
+                    # roll back this AND every not-yet-emitted transition
+                    # of the tick, so surfaced state never diverges from
+                    # the log and the next tick re-attempts the same
+                    # crossings instead of losing them.
+                    with self._lock:
+                        for u in transitions[i:]:
+                            if u["state"] == "firing":
+                                self._firing.pop(u["rule"], None)
+                            else:
+                                self._firing[u["rule"]] = {
+                                    **u, "state": "firing"
+                                }
+                    return transitions[:i]
+        return transitions
+
+    def active(self) -> list[dict]:
+        """Currently firing alerts (copies, newest values)."""
+        with self._lock:
+            return [dict(v) for v in self._firing.values()]
+
+
+def start_evaluator(
+    engine: SloEngine,
+    snapshot_fn: Callable[[], dict],
+    emit,
+    interval_s: float,
+) -> "tuple[threading.Thread, threading.Event]":
+    """Run the engine on a daemon thread every ``interval_s`` seconds
+    until the returned stop event is set. A snapshot failure skips the
+    tick and retries next cadence (emit failures are already rolled
+    back inside :meth:`SloEngine.evaluate`): a transient error must not
+    permanently kill the judge — a dead evaluator would freeze
+    ``/healthz`` at whatever state it last surfaced."""
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.is_set():
+            try:
+                engine.evaluate(snapshot_fn(), emit)
+            except Exception:
+                pass  # transient; the wait below bounds the retry rate
+            stop.wait(max(interval_s, 0.01))
+
+    thread = threading.Thread(target=loop, name="serve-slo", daemon=True)
+    thread.start()
+    return thread, stop

@@ -1,0 +1,266 @@
+"""Live terminal dashboard: ``top`` for drift-serving runs.
+
+    python -m distributed_drift_detection_tpu top <run.jsonl | dir>... \\
+        [--statusz URL]... [--interval S] [--once]
+
+``watch`` renders one run as a status line; ``top`` renders a fleet as a
+refreshing table — throughput, latency percentiles, drift rate,
+quarantine rate, and active alerts for one or many runs at once. Two
+data sources, freely mixed:
+
+* **run logs / telemetry dirs** (positional args — a directory resolves
+  to its newest run log): tailed incrementally with the same
+  :class:`~.watch.LogTail` the watch CLI uses, folded through
+  :class:`~.watch.WatchState` plus the ops-plane extras (``alert``
+  transitions, quarantine counts riding on ``run_completed``);
+* **``--statusz`` URLs** (a serving daemon's ``--ops-port``): the JSON
+  snapshot carries what a log cannot — live latency percentiles,
+  queue depth, quarantine share — fetched fresh every frame with a
+  short timeout (an unreachable daemon renders as ``down``, never
+  crashes the dashboard).
+
+Rates are deltas between frames (cumulative ÷ uptime on the first
+frame / ``--once``). Pure stdlib, no jax — runs wherever the artifacts
+or endpoints are reachable, same contract as ``watch``/``report``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from .watch import LogTail, WatchState, resolve_log
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _frame_rate(prev, now_mono, rows, fallback):
+    """Rows/s from the delta against the previous frame; returns
+    ``(rate, new prev)``. A computed delta of 0 means 0 — a stalled run
+    must never fall back to a healthy-looking cumulative average —
+    ``fallback()`` serves only the first frame / ``--once``."""
+    if rows is None:
+        return None, prev
+    if prev is not None:
+        dt = now_mono - prev[0]
+        rate = (rows - prev[1]) / dt if dt > 0 and rows >= prev[1] else None
+    else:
+        rate = fallback()
+    return rate, (now_mono, rows)
+
+
+class LogSource:
+    """One tailed run log folded into dashboard columns."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.tail = LogTail(path)
+        self.state = WatchState()  # folds alerts too (watch.py)
+        self.quarantined = 0
+        self._prev: "tuple[float, int] | None" = None  # (poll mono, rows)
+
+    def poll(self, now_mono: float) -> dict:
+        events = self.tail.poll()
+        self.state.fold(events)
+        for e in events:
+            if e["type"] == "rows_quarantined":
+                self.quarantined += int(e["rows"])
+            elif e["type"] == "run_completed":
+                self.quarantined = int(
+                    e.get("rows_quarantined") or self.quarantined
+                )
+        s = self.state
+        rows = s.rows_done
+        if rows is None and s.completed is not None:
+            rows = int(s.completed["rows"])
+        rate, self._prev = _frame_rate(self._prev, now_mono, rows, s.rate)
+        age = None if s.last_ts is None else max(time.time() - s.last_ts, 0.0)
+        return {
+            "run": s.run_id or os.path.basename(self.path),
+            "status": "done" if s.completed is not None else "live",
+            "rows": rows,
+            "rows_per_sec": rate,
+            "p50_ms": None,
+            "p99_ms": None,
+            "detections": s.detections,
+            "quarantined": self.quarantined,
+            "alerts": sorted(s.alerts),
+            "age_s": age,
+        }
+
+
+class StatuszSource:
+    """One serving daemon's ``/statusz`` endpoint → dashboard columns."""
+
+    def __init__(self, url: str, *, timeout: float = 2.0):
+        self.url = url if "://" in url else "http://" + url
+        if not self.url.rstrip("/").endswith("/statusz"):
+            self.url = self.url.rstrip("/") + "/statusz"
+        self.timeout = timeout
+        self._prev: "tuple[float, int] | None" = None
+
+    def poll(self, now_mono: float) -> dict:
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout) as r:
+                s = json.load(r)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return {
+                "run": self.url,
+                "status": "down",
+                "rows": None,
+                "rows_per_sec": None,
+                "p50_ms": None,
+                "p99_ms": None,
+                "detections": None,
+                "quarantined": None,
+                "alerts": [f"unreachable: {getattr(e, 'reason', e)}"],
+                "age_s": None,
+            }
+        rows = (s.get("rows") or {}).get("published")
+        rate, self._prev = _frame_rate(
+            self._prev,
+            now_mono,
+            rows,
+            lambda: rows / s["uptime_s"] if rows and s.get("uptime_s") else None,
+        )
+        lat = s.get("latency_ms") or {}
+        return {
+            "run": s.get("run_id") or self.url,
+            "status": "draining" if s.get("draining") else "live",
+            "rows": rows,
+            "rows_per_sec": rate,
+            "p50_ms": lat.get("p50"),
+            "p99_ms": lat.get("p99"),
+            "detections": s.get("detections"),
+            "quarantined": (s.get("rows") or {}).get("quarantined"),
+            "alerts": sorted(a["rule"] for a in s.get("alerts") or []),
+            "age_s": s.get("last_verdict_age_s"),
+        }
+
+
+_COLUMNS = (
+    ("RUN", "run", 38),
+    ("ST", "status", 8),
+    ("ROWS", "rows", 12),
+    ("ROWS/S", "rows_per_sec", 10),
+    ("P50ms", "p50_ms", 10),
+    ("P99ms", "p99_ms", 10),
+    ("DET", "detections", 7),
+    ("QUAR", "quarantined", 7),
+    ("AGE", "age_s", 7),
+    ("ALERTS", "alerts", 0),
+)
+
+
+def _cell(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, list):
+        return ",".join(str(v) for v in value) or "-"
+    if isinstance(value, float):
+        return f"{value:,.1f}"
+    return f"{value:,}" if isinstance(value, int) else str(value)
+
+
+def render(rows: list[dict], now: float) -> str:
+    """One dashboard frame (pure function of the polled rows — tests pin
+    it without a terminal)."""
+    header = "".join(
+        (f"{h:<{w}}" if w else h) for h, _, w in _COLUMNS
+    ).rstrip()
+    lines = [
+        time.strftime("top  %Y-%m-%d %H:%M:%S", time.localtime(now))
+        + f"  ({len(rows)} run{'s' if len(rows) != 1 else ''})",
+        header,
+    ]
+    for r in rows:
+        cells = []
+        for _, key, w in _COLUMNS:
+            text = _cell(r.get(key))
+            cells.append(f"{text:<{w}}" if w else text)
+        lines.append("".join(cells).rstrip())
+    firing = sum(
+        1 for r in rows if r.get("alerts") and r.get("status") != "down"
+    )
+    if firing:
+        lines.append(f"!! {firing} run(s) with active alerts")
+    return "\n".join(lines)
+
+
+def top(
+    targets: list[str],
+    statusz: list[str],
+    *,
+    interval: float = 2.0,
+    once: bool = False,
+    out=print,
+    sleep=time.sleep,
+    frames: "int | None" = None,
+) -> int:
+    """Drive the dashboard; returns an exit code (0 ok, 4 = nothing to
+    show — no resolvable log and no endpoint, the watch convention)."""
+    sources: list = []
+    for t in targets:
+        path = resolve_log(t)
+        if path is not None:
+            sources.append(LogSource(path))
+        else:
+            out(f"top: no run log at {t}")
+    sources.extend(StatuszSource(u) for u in statusz)
+    if not sources:
+        return 4
+    n = 0
+    while True:
+        now_mono = time.monotonic()
+        rows = [s.poll(now_mono) for s in sources]
+        frame = render(rows, time.time())
+        out(frame if once else _CLEAR + frame)
+        n += 1
+        if once or (frames is not None and n >= frames):
+            return 0
+        sleep(interval)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu top",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "targets",
+        nargs="*",
+        help="run-log *.jsonl files or telemetry directories (newest run)",
+    )
+    ap.add_argument(
+        "--statusz",
+        action="append",
+        default=[],
+        metavar="URL",
+        help="a serving daemon's ops endpoint (host:port or full URL), "
+        "repeatable — adds live latency/queue columns",
+    )
+    ap.add_argument("--interval", type=float, default=2.0, metavar="S")
+    ap.add_argument(
+        "--once", action="store_true", help="print one frame and exit"
+    )
+    args = ap.parse_args(argv)
+    if not args.targets and not args.statusz:
+        ap.error("nothing to watch: give a run log/dir or --statusz URL")
+    raise SystemExit(
+        top(
+            args.targets,
+            args.statusz,
+            interval=args.interval,
+            once=args.once,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -98,6 +98,11 @@ class WatchState:
         self.detections = 0
         self.chunks = 0
         self.legs = 0
+        # Currently firing SLO alerts (serving daemons emit `alert`
+        # transitions; firing adds, resolved removes) — rendered in the
+        # status line so a watched daemon's degradation is visible
+        # without scraping /healthz.
+        self.alerts: dict[str, dict] = {}
         self.n_events = 0
         self.last_ts: float | None = None
         self.last_type: str | None = None
@@ -127,6 +132,11 @@ class WatchState:
             elif t == "leg_completed":
                 self.legs += 1
                 self.detections += int(e["detections"] or 0)
+            elif t == "alert":
+                if e["state"] == "firing":
+                    self.alerts[e["rule"]] = e
+                else:
+                    self.alerts.pop(e["rule"], None)
             elif t == "run_completed":
                 self.completed = e
 
@@ -170,6 +180,8 @@ class WatchState:
             bits.append(f"{self.legs} legs")
         if self.detections:
             bits.append(f"{self.detections} detections")
+        if self.alerts:
+            bits.append("ALERTS " + ",".join(sorted(self.alerts)))
         if self.last_ts is not None:
             bits.append(f"last {self.last_type} {now - self.last_ts:.1f}s ago")
         return "  ".join(bits)

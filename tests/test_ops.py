@@ -1,0 +1,649 @@
+"""Ops plane (ISSUE 8): HTTP metrics/health endpoints, end-to-end row
+tracing, SLO alerting, the crash flight recorder, the `top` dashboard,
+and the watch CLI's age-based stall contract.
+
+The headline acceptance: while a daemon serves real socket traffic, the
+live ``/metrics`` scrape carries ``serve_row_latency_seconds`` histograms
+whose p99 agrees with the loadgen's sidecar-derived p99; an injected
+stall fires an ``alert`` event and flips ``/healthz`` non-200; a crashed
+daemon leaves a readable flight-recorder dump and a drained one leaves
+none.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.config import RunConfig, ServeParams
+from distributed_drift_detection_tpu.resilience import faults
+from distributed_drift_detection_tpu.telemetry import registry
+from distributed_drift_detection_tpu.telemetry.events import EventLog, read_events
+from distributed_drift_detection_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    write_exports,
+)
+from distributed_drift_detection_tpu.telemetry.ops import (
+    FLIGHTREC_SUFFIX,
+    FlightRecorder,
+    OpsServer,
+    read_flight_record,
+)
+from distributed_drift_detection_tpu.telemetry.slo import (
+    SloEngine,
+    SloRule,
+    parse_rules,
+)
+from distributed_drift_detection_tpu.telemetry.trace import (
+    hist_quantile,
+    latency_histogram,
+    observe_array,
+    prom_histogram_quantile,
+)
+from distributed_drift_detection_tpu.telemetry import top as top_mod
+from distributed_drift_detection_tpu.telemetry import watch as watch_mod
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# --- trace: vectorized observe + quantiles ---------------------------------
+
+
+def test_observe_array_matches_scalar_observe():
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    ha, hb = latency_histogram(reg_a), latency_histogram(reg_b)
+    rng = np.random.default_rng(0)
+    values = np.concatenate(
+        [
+            rng.uniform(0, 2.0, 200),
+            np.array(ha.buckets[:5]),  # exactly on bucket edges
+            np.array([1e9]),  # overflow slot
+        ]
+    )
+    for v in values:
+        ha.observe(float(v), stage="total")
+    observe_array(hb, values, stage="total")
+    # bit-identical bucket counts, sum within float tolerance
+    (ka, sa), (kb, sb) = ha.values.items().__iter__().__next__(), next(
+        iter(hb.values.items())
+    )
+    assert ka == kb
+    assert sa[0] == sb[0]
+    assert sa[2] == sb[2]
+    assert sa[1] == pytest.approx(sb[1])
+    # and the rendered exposition agrees byte-for-byte
+    sa[1] = sb[1] = round(sa[1], 9)
+    assert reg_a.to_prometheus_text() == reg_b.to_prometheus_text()
+
+
+def test_hist_quantile_agrees_with_scrape_side():
+    reg = MetricsRegistry()
+    h = latency_histogram(reg)
+    rng = np.random.default_rng(1)
+    observe_array(h, rng.exponential(0.1, 500), stage="total")
+    observe_array(h, rng.exponential(0.5, 100), stage="device")
+    parsed = parse_prometheus_text(reg.to_prometheus_text())
+    for q in (0.5, 0.9, 0.99):
+        live = hist_quantile(h, q, stage="total")
+        scraped = prom_histogram_quantile(
+            parsed, "serve_row_latency_seconds", q, stage="total"
+        )
+        assert live == pytest.approx(scraped)
+        assert live > 0
+    # unknown label set → None, empty histogram → None
+    assert hist_quantile(h, 0.5, stage="nope") is None
+    assert prom_histogram_quantile(parsed, "no_such_metric", 0.5) is None
+
+
+# --- ops server: /metrics byte-compat, routing -----------------------------
+
+
+def test_http_metrics_byte_identical_to_prom_export(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("rows_total", help="rows").inc(41, partition="3")
+    reg.gauge("compile_seconds", help="s").set(1.25)
+    h = reg.histogram("phase_seconds", help="phases")
+    for v in (0.004, 0.2, 7.0):
+        h.observe(v, phase="detect", path='C:\\new\n"dir"')
+    srv = OpsServer(
+        "127.0.0.1",
+        0,
+        metrics_fn=reg.to_prometheus_text,
+        health_fn=lambda: (200, {"status": "ok"}),
+        status_fn=dict,
+    )
+    srv.start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/metrics")
+    finally:
+        srv.stop()
+    assert code == 200
+    _, prom_path = write_exports(reg, str(tmp_path / "run"))
+    with open(prom_path, "rb") as fh:
+        assert body == fh.read()  # byte-identical to the file exporter
+    # and the round trip re-parses identically (histogram _bucket/_sum/
+    # _count + label escaping over HTTP)
+    assert parse_prometheus_text(body.decode()) == parse_prometheus_text(
+        open(prom_path).read()
+    )
+    assert ("rows_total", (("partition", "3"),)) in parse_prometheus_text(
+        body.decode()
+    )
+
+
+def test_ops_routing_health_status_404():
+    state = {"code": 200}
+    srv = OpsServer(
+        "127.0.0.1",
+        0,
+        metrics_fn=lambda: None,  # no registry → empty exposition
+        health_fn=lambda: (state["code"], {"status": "x"}),
+        status_fn=lambda: {"rows": {"published": 7}},
+    )
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert _get(base + "/healthz")[0] == 200
+        state["code"] = 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/healthz")
+        assert ei.value.code == 503
+        assert json.load(ei.value)["status"] == "x"
+        code, body = _get(base + "/statusz")
+        assert code == 200 and json.loads(body)["rows"]["published"] == 7
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# --- SLO engine ------------------------------------------------------------
+
+
+def test_parse_rules():
+    rules = parse_rules(["p99_ms=250", "stall_s=60"])
+    assert rules == (SloRule("p99_ms", 250.0), SloRule("stall_s", 60.0))
+    assert parse_rules(["none"]) == ()
+    with pytest.raises(ValueError):
+        parse_rules(["bogus_kind=1"])
+    with pytest.raises(ValueError):
+        parse_rules(["p99_ms=abc"])
+    with pytest.raises(ValueError):
+        parse_rules(["p99_ms"])
+    with pytest.raises(ValueError):  # two thresholds on one kind would
+        parse_rules(["p99_ms=100", "p99_ms=500"])  # fight forever
+
+
+def test_slo_engine_transitions_and_events(tmp_path):
+    log = EventLog(str(tmp_path / "r.jsonl"))
+    engine = SloEngine(parse_rules(["p99_ms=100", "stall_s=5"]))
+    # not measurable → nothing
+    assert engine.evaluate({"p99_ms": None, "stall_s": None}, log.emit) == []
+    # cross into violation → one firing, once (no re-fire per tick)
+    t1 = engine.evaluate({"p99_ms": 250.0, "stall_s": 1.0}, log.emit)
+    assert [(t["rule"], t["state"]) for t in t1] == [("p99_ms", "firing")]
+    assert engine.evaluate({"p99_ms": 300.0, "stall_s": 1.0}, log.emit) == []
+    assert engine.active()[0]["value"] == 300.0  # surfaced value stays fresh
+    # cross back → resolved
+    t2 = engine.evaluate({"p99_ms": 50.0, "stall_s": 1.0}, log.emit)
+    assert [(t["rule"], t["state"]) for t in t2] == [("p99_ms", "resolved")]
+    assert engine.active() == []
+    log.close()
+    events = read_events(log.path)  # schema-validates the alert events
+    assert [(e["rule"], e["state"]) for e in events] == [
+        ("p99_ms", "firing"),
+        ("p99_ms", "resolved"),
+    ]
+    assert all(e["type"] == "alert" and e["threshold"] == 100.0 for e in events)
+
+
+def test_slo_emit_failure_rolls_back_and_retries(tmp_path):
+    """A refused alert emit must not freeze surfaced state out of sync
+    with the log: the transition rolls back and the next tick re-fires."""
+    engine = SloEngine(parse_rules(["stall_s=5"]))
+    calls = {"n": 0}
+
+    def flaky_emit(etype, **fields):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+
+    engine.evaluate({"stall_s": 9.0}, flaky_emit)
+    assert engine.active() == []  # rolled back: log and state agree
+    t = engine.evaluate({"stall_s": 9.0}, flaky_emit)  # next tick re-fires
+    assert [x["state"] for x in t] == ["firing"] and calls["n"] == 2
+    assert [a["rule"] for a in engine.active()] == ["stall_s"]
+
+
+def test_top_frame_rate_stalled_run_reads_zero():
+    """A wedged run must render 0 rows/s on later frames, never fall
+    back to the healthy-looking cumulative average."""
+    rate, prev = top_mod._frame_rate(None, 100.0, 5000, lambda: 2500.0)
+    assert rate == 2500.0  # first frame: cumulative fallback
+    rate, prev = top_mod._frame_rate(prev, 102.0, 5000, lambda: 2500.0)
+    assert rate == 0.0  # no progress since last frame → zero, not 2500
+    rate, prev = top_mod._frame_rate(prev, 104.0, 5200, lambda: 2500.0)
+    assert rate == pytest.approx(100.0)
+
+
+# --- flight recorder -------------------------------------------------------
+
+
+def test_flight_recorder_ring_dump_and_staleness(tmp_path):
+    clk = [0.0]
+    rec = FlightRecorder(3, clock=lambda: clk[0])
+    assert rec.dump(str(tmp_path / "none.jsonl")) is None  # empty → no file
+    assert not (tmp_path / "none.jsonl").exists()
+    log = EventLog(str(tmp_path / "r.jsonl"), clock=lambda: 123.0)
+    log.tap = rec.record
+    for i in range(5):
+        log.emit("heartbeat", rows_done=i, elapsed_s=float(i))
+    clk[0] = 10.0
+    assert rec.event_age_s() == pytest.approx(10.0)
+    # an alert event rides in the ring but does NOT reset staleness
+    log.emit("alert", rule="stall_s", state="firing", value=9.0, threshold=5.0)
+    assert rec.event_age_s() == pytest.approx(10.0)
+    path = rec.dump(str(tmp_path / ("r" + FLIGHTREC_SUFFIX)))
+    events = read_flight_record(path)
+    assert len(events) == 3  # bounded ring: only the newest N
+    assert events[-1]["type"] == "alert"
+    assert [e["rows_done"] for e in events[:-1]] == [3, 4]
+    log.close()
+
+
+def test_newest_run_log_skips_flightrec_sidecar(tmp_path):
+    log = EventLog(str(tmp_path / "run-1.jsonl"))
+    log.emit("run_started", run_id="run-1", config={})
+    log.close()
+    time.sleep(0.02)
+    # a newer flight-recorder dump must never resolve as "the newest run"
+    (tmp_path / ("run-1" + FLIGHTREC_SUFFIX)).write_text(
+        json.dumps({"v": 1, "type": "heartbeat", "ts": 0, "seq": 0,
+                    "rows_done": 1, "elapsed_s": 1.0}) + "\n"
+    )
+    assert registry.newest_run_log(str(tmp_path)) == log.path
+
+
+# --- live daemon: endpoints + latency parity + stall + crash ---------------
+
+
+def _live_cfg(tmp_path, **kw):
+    return RunConfig(
+        partitions=2,
+        per_batch=25,
+        model="centroid",
+        window=1,
+        data_policy="quarantine",
+        results_csv="",
+        telemetry_dir=str(tmp_path / "tele"),
+        **kw,
+    )
+
+
+def _stream(rows_per_class=100):
+    from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+
+    return rialto_like_xy(seed=0, rows_per_class=rows_per_class)
+
+
+def test_live_daemon_metrics_p99_agrees_with_sidecar(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import (
+        format_lines,
+        run_loadgen,
+    )
+
+    X, y = _stream()
+    cfg = _live_cfg(tmp_path)
+    params = ServeParams(
+        num_features=X.shape[1],
+        num_classes=10,
+        port=0,
+        ops_port=0,
+        chunk_batches=2,
+        linger_s=0.05,
+    )
+    runner = ServeRunner(cfg, params)
+    banner = runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    lines = format_lines(X[:800], y[:800])
+    rep = run_loadgen(
+        "127.0.0.1",
+        banner["port"],
+        lines,
+        verdicts=banner["verdicts"],
+        timeout=120,
+    )
+    assert rep["rows_covered"] == 800 and rep["p99_ms"] > 0
+    base = f"http://127.0.0.1:{banner['ops_port']}"
+    code, body = _get(base + "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert "serve_row_latency_seconds_bucket" in text
+    parsed = parse_prometheus_text(text)
+    live_p99_ms = (
+        prom_histogram_quantile(
+            parsed, "serve_row_latency_seconds", 0.99, stage="total"
+        )
+        * 1000.0
+    )
+    # The live histogram and the loadgen's post-hoc sidecar attribution
+    # measure the same pipeline with different clocks and bucket
+    # quantization — they must agree within histogram-bucket tolerance.
+    assert live_p99_ms > 0
+    lo = min(rep["p99_ms"] / 4.0, rep["p99_ms"] - 150.0)
+    hi = max(rep["p99_ms"] * 4.0, rep["p99_ms"] + 150.0)
+    assert lo <= live_p99_ms <= hi, (live_p99_ms, rep["p99_ms"])
+    # every pipeline stage landed samples
+    for stage in ("admission", "queue", "device", "collect", "total"):
+        assert (
+            prom_histogram_quantile(
+                parsed, "serve_row_latency_seconds", 0.5, stage=stage
+            )
+            is not None
+        ), stage
+    status = json.loads(_get(base + "/statusz")[1])
+    assert status["rows"]["ingress_seen"] == 800
+    assert status["rows"]["published"] == 800
+    # statusz rounds to 3 decimals
+    assert status["latency_ms"]["p99"] == pytest.approx(live_p99_ms, abs=0.01)
+    assert status["compile"]["aot_shapes"] >= 1
+    assert _get(base + "/healthz")[0] == 200
+    runner.request_stop()
+    thread.join(60)
+    assert not thread.is_alive()
+    # ops plane torn down with the daemon
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(base + "/healthz", timeout=1)
+
+
+def test_stall_alert_flips_healthz_then_clean_drain(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    faults.arm("serve.flush", kind="stall", at=1, seconds=1.5)
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    params = ServeParams(
+        num_features=X.shape[1],
+        num_classes=10,
+        port=None,
+        ops_port=0,
+        chunk_batches=2,
+        linger_s=0.05,
+        heartbeat_s=0.1,
+        slo=("stall_s=0.4",),
+        slo_interval_s=0.05,
+    )
+    runner = ServeRunner(cfg, params)
+    banner = runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    runner.admission.admit_lines(format_lines(X[:100], y[:100]))
+    runner.batcher.flush()
+    base = f"http://127.0.0.1:{banner['ops_port']}"
+    flipped = None
+    for _ in range(100):  # the injected 1.5 s stall must flip /healthz
+        try:
+            _get(base + "/healthz", timeout=2)
+        except urllib.error.HTTPError as e:
+            flipped = (e.code, json.load(e))
+            break
+        time.sleep(0.05)
+    assert flipped is not None and flipped[0] == 503
+    assert flipped[1]["status"] == "degraded"
+    assert [a["rule"] for a in flipped[1]["alerts"]] == ["stall_s"]
+    time.sleep(1.6)  # stall ends; the loop publishes and the alert resolves
+    runner.request_stop()
+    thread.join(60)
+    assert not thread.is_alive()
+    alerts = [
+        (e["rule"], e["state"])
+        for e in read_events(banner["run_log"])
+        if e["type"] == "alert"
+    ]
+    assert alerts == [("stall_s", "firing"), ("stall_s", "resolved")]
+    # clean drain: completed in the registry, NO flight-recorder dump
+    runs = registry.runs(cfg.telemetry_dir)
+    assert all(r["status"] == "completed" for r in runs.values())
+    assert not list((tmp_path / "tele").glob("*" + FLIGHTREC_SUFFIX))
+
+
+def test_crashed_daemon_leaves_flight_recorder_dump(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    faults.arm("serve.flush", kind="raise", at=1)
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    runner = ServeRunner(
+        cfg,
+        ServeParams(
+            num_features=X.shape[1],
+            num_classes=10,
+            port=None,
+            chunk_batches=2,
+            linger_s=0.05,
+        ),
+    )
+    banner = runner.start()
+    runner.admission.admit_lines(format_lines(X[:100], y[:100]))
+    runner.batcher.flush()
+    runner.request_stop()
+    with pytest.raises(faults.InjectedFault):
+        runner.serve_forever()
+    (dump,) = list((tmp_path / "tele").glob("*" + FLIGHTREC_SUFFIX))
+    events = read_flight_record(str(dump))
+    assert events and {"run_started", "compile_completed"} <= {
+        e["type"] for e in events
+    }
+    # the dump is a sidecar: the run log still resolves as newest
+    assert registry.newest_run_log(cfg.telemetry_dir) == banner["run_log"]
+    runs = registry.runs(cfg.telemetry_dir)
+    assert all(r["status"] == "failed" for r in runs.values())
+
+
+# --- perf CLI: serve p99 is gated, stall-aware -----------------------------
+
+
+def test_perf_gates_serve_p99_stall_aware():
+    from distributed_drift_detection_tpu.telemetry.perf import diff_benches
+
+    old = {
+        "serve_p99_ms": 100.0,
+        "serve_registry_p99_ms": 105.0,
+        "serve_timeout": False,
+        "serve_drained": True,
+    }
+    new = dict(old, serve_p99_ms=200.0, serve_registry_p99_ms=210.0)
+    _, regs = diff_benches([("a", old, []), ("b", new, [])], 0.10)
+    gating = [r.cell for r in regs if not r.suspect]
+    # sidecar p99 gates; the registry twin prints informationally
+    assert gating == ["serve_p99_ms"]
+    # a timed-out (or undrained) serve probe marks the pair suspect:
+    # reported, never failing the exit code — a wedged host is not a
+    # code regression
+    sus = dict(new, serve_timeout=True)
+    _, regs = diff_benches([("a", old, []), ("c", sus, [])], 0.10)
+    assert regs and all(r.suspect for r in regs)
+    und = dict(new, serve_drained=False)
+    _, regs = diff_benches([("a", old, []), ("d", und, [])], 0.10)
+    assert regs and all(r.suspect for r in regs)
+
+
+# --- watch: the stall contract keys off AGE, not presence ------------------
+
+
+def _heartbeat_log(tmp_path, ts0=1000.0, beats=5, period=1.0):
+    clk = {"t": ts0}
+    log = EventLog(str(tmp_path / "run-hb.jsonl"), clock=lambda: clk["t"])
+    log.emit("run_started", run_id="run-hb", config={})
+    for i in range(beats):
+        clk["t"] = ts0 + i * period
+        log.emit("heartbeat", rows_done=100 * (i + 1), elapsed_s=i * period)
+    log.close()
+    return log.path, ts0 + (beats - 1) * period
+
+
+def test_watch_stall_keys_off_heartbeat_age_not_presence(tmp_path):
+    path, last_ts = _heartbeat_log(tmp_path)
+    # heartbeats PRESENT but old: a wedged daemon must read stalled...
+    rc = watch_mod.watch(
+        path, stall_after=50, once=True, clock=lambda: last_ts + 100,
+        out=lambda *a: None,
+    )
+    assert rc == watch_mod.EXIT_STALLED
+    # ...while the same log with fresh heartbeats reads healthy (idle is
+    # not dead: age, not progress, drives the contract)
+    rc = watch_mod.watch(
+        path, stall_after=50, once=True, clock=lambda: last_ts + 10,
+        out=lambda *a: None,
+    )
+    assert rc == watch_mod.EXIT_OK
+
+
+def test_watch_empty_dir_exits_4(tmp_path):
+    rc = watch_mod.watch(str(tmp_path), once=True, out=lambda *a: None)
+    assert rc == watch_mod.EXIT_NO_LOG
+
+
+def test_watch_live_idle_daemon_heartbeats_healthy(tmp_path, monkeypatch):
+    """A live daemon with NO traffic keeps heartbeating: `watch` against
+    the serving directory must exit healthy (idle ≠ stalled), and after
+    the heartbeats AGE past the bar it must exit stalled."""
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    runner = ServeRunner(
+        cfg,
+        ServeParams(
+            num_features=X.shape[1],
+            num_classes=10,
+            port=None,
+            chunk_batches=2,
+            heartbeat_s=0.05,
+        ),
+    )
+    runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    try:
+        time.sleep(0.4)  # several idle heartbeats
+        rc = watch_mod.watch(
+            cfg.telemetry_dir, stall_after=5, once=True, out=lambda *a: None
+        )
+        assert rc == watch_mod.EXIT_OK
+    finally:
+        runner.request_stop()
+        thread.join(60)
+    assert not thread.is_alive()
+    # drained: the completed run reads healthy regardless of age
+    rc = watch_mod.watch(
+        cfg.telemetry_dir, stall_after=0.01, once=True, out=lambda *a: None
+    )
+    assert rc == watch_mod.EXIT_OK
+
+
+# --- top dashboard ---------------------------------------------------------
+
+
+def test_top_renders_log_with_alerts_and_quarantine(tmp_path):
+    clk = {"t": 2000.0}
+    log = EventLog(str(tmp_path / "run-top.jsonl"), clock=lambda: clk["t"])
+    log.emit("run_started", run_id="run-top", config={})
+    log.emit("heartbeat", rows_done=5000, elapsed_s=2.0)
+    log.emit("rows_quarantined", rows=7, policy="quarantine")
+    log.emit("alert", rule="p99_ms", state="firing", value=900.0, threshold=250.0)
+    log.close()
+    frames = []
+    rc = top_mod.top(
+        [str(tmp_path)], [], once=True, out=frames.append
+    )
+    assert rc == 0
+    (frame,) = frames
+    assert "run-top" in frame and "p99_ms" in frame and "5,000" in frame
+    assert "7" in frame  # quarantined column
+    assert "active alerts" in frame
+    # a resolved alert clears the column
+    log2 = EventLog(log.path, clock=lambda: clk["t"])
+    log2.emit(
+        "alert", rule="p99_ms", state="resolved", value=90.0, threshold=250.0
+    )
+    log2.close()
+    frames.clear()
+    assert top_mod.top([log.path], [], once=True, out=frames.append) == 0
+    assert "active alerts" not in frames[0]
+
+
+def test_top_statusz_source_down_and_nothing(tmp_path):
+    frames = []
+    # unreachable endpoint renders as down, never crashes the dashboard
+    rc = top_mod.top(
+        [], ["127.0.0.1:1/statusz"], once=True, out=frames.append
+    )
+    assert rc == 0 and "down" in frames[0]
+    # nothing resolvable at all → exit 4 (the watch convention)
+    assert top_mod.top([str(tmp_path / "nope")], [], once=True, out=frames.append) == 4
+
+
+def test_top_statusz_source_against_live_ops(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    runner = ServeRunner(
+        cfg,
+        ServeParams(
+            num_features=X.shape[1],
+            num_classes=10,
+            port=None,
+            ops_port=0,
+            chunk_batches=2,
+            linger_s=0.05,
+        ),
+    )
+    banner = runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    try:
+        runner.admission.admit_lines(format_lines(X[:200], y[:200]))
+        runner.batcher.flush()
+        deadline = time.monotonic() + 30
+        while runner._rows_published < 200 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        frames = []
+        rc = top_mod.top(
+            [], [f"127.0.0.1:{banner['ops_port']}"], once=True,
+            out=frames.append,
+        )
+        assert rc == 0
+        assert banner["run_log"].split("/")[-1][:-6] in frames[0]
+        assert "200" in frames[0]  # published rows column
+    finally:
+        runner.request_stop()
+        thread.join(60)
+    assert not thread.is_alive()

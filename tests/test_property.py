@@ -7,6 +7,10 @@ example; hypothesis varies contents, carried state, and thresholds.
 
 import numpy as np
 import pytest
+
+# Optional dependency (the `test`/`dev` extras install it): a bare
+# environment must still *collect* this suite cleanly — skip, not error.
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import jax

@@ -44,6 +44,9 @@ EXAMPLE_EVENTS = {
         source="memory_analysis", stats={"temp_bytes": 14_401_584}
     ),
     "rows_quarantined": dict(rows=3, policy="quarantine"),
+    "alert": dict(
+        rule="stall_s", state="firing", value=12.5, threshold=5.0
+    ),
     "run_retried": dict(
         attempt=1, max_attempts=3, reason="RuntimeError: device lost",
         backoff_s=0.55,
