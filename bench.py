@@ -327,6 +327,163 @@ def _soak_stats(total_rows: int, chained_proof: bool = True) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Multi-tenant aggregate throughput (ISSUE 9 tentpole): T independent
+# streams stacked into ONE compiled kernel vs T sequential solo runs.
+# --------------------------------------------------------------------------
+
+
+def _tenant_stats(
+    tenant_counts=(8, 64), rows_per_class: int = 200, reps: int = 3
+) -> dict:
+    """The tenant-plane headline: for each T, run T independent streams
+    (per-tenant seeds, same kernel geometry) BOTH ways — stacked through
+    one ``[T·P, NB, B]`` kernel (``api.prepare_multi``) and as T
+    sequential solo spans — and record aggregate rows/s for each, the
+    speedup, and the bit-parity verdict (every tenant's stacked flags
+    must equal its solo run's; a mismatch raises — the artifact can never
+    carry a tenant headline over broken tenancy). Both paths are warmed
+    before timing (compile excluded from every span); the win being
+    measured is dispatch + collect amortization across the tenant axis,
+    which is exactly what a per-user/per-sensor serving fleet pays T
+    times over without the stacked plane."""
+    import jax
+
+    from distributed_drift_detection_tpu.api import prepare, prepare_multi
+    from distributed_drift_detection_tpu.config import RunConfig
+    from distributed_drift_detection_tpu.parallel import shard_batches
+    from distributed_drift_detection_tpu.parallel.mesh import (
+        host_flags,
+        split_tenant_flags,
+    )
+
+    out = {}
+    for tcount in tenant_counts:
+        base = RunConfig(
+            dataset=(
+                "synth:rialto,seed={tenant},rows_per_class=%d" % rows_per_class
+            ),
+            partitions=8,
+            per_batch=100,
+            model="centroid",
+            window=1,
+            results_csv="",
+            tenants=int(tcount),
+        )
+        prep = prepare_multi(base)
+        if min(prep.nb_list) < 2:
+            # NB=1 leaves no flag rows (batch 0 only seeds batch_a): the
+            # parity assertion would compare zero-width tables and the
+            # window engine cannot even run the geometry — refuse loudly
+            # instead of emitting a vacuous headline.
+            raise ValueError(
+                f"rows_per_class={rows_per_class} gives only "
+                f"{min(prep.nb_list)} microbatch(es) per partition at the "
+                "bench geometry (8 partitions x 100 per_batch); use >= "
+                "100 so every tenant has at least 2"
+            )
+        rows_total = sum(s.num_rows for s in prep.streams)
+
+        def span_multi():
+            db, dk = shard_batches(prep.batches, prep.keys, prep.mesh)
+            o = (prep.exec_fn or prep.runner)(db, dk)
+            jax.block_until_ready(o)
+            return host_flags(o)[0]
+
+        flags = span_multi()  # warm (compile + one-time device setup)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            flags = span_multi()
+            times.append(time.perf_counter() - t0)
+        # min-of-reps on BOTH sides: interference (a noisy CI neighbor, a
+        # scheduler stall) can only inflate a span, never deflate it, so
+        # the fastest rep is the robust estimator for the amortization
+        # claim — a stall would have to hit every rep of one side to skew
+        # the agg-vs-seq comparison.
+        multi_s = float(min(times))
+
+        # Solo baselines from the RESOLVED per-tenant configs (the plane
+        # pins auto knobs against tenant 0's geometry): the parity claim
+        # is solo-run-of-the-resolved-config, same as the CI smoke —
+        # unresolved configs would re-resolve auto knobs per stream and
+        # compare different programs on ragged tenants.
+        preps = [
+            prepare(c, stream=s)
+            for c, s in zip(prep.configs, prep.streams)
+        ]
+
+        def span_solo(pr):
+            db, dk = shard_batches(pr.batches, pr.keys, pr.mesh)
+            o = (pr.exec_fn or pr.runner)(db, dk)
+            jax.block_until_ready(o)
+            return host_flags(o)[0]
+
+        solo_flags = [span_solo(pr) for pr in preps]  # warm + parity ref
+        seq_times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for pr in preps:
+                span_solo(pr)
+            seq_times.append(time.perf_counter() - t0)
+        seq_s = float(min(seq_times))
+
+        per = split_tenant_flags(
+            flags, tcount, flag_cols=[nb - 1 for nb in prep.nb_list]
+        )
+        for t in range(tcount):
+            for name in per[t]._fields:
+                if not np.array_equal(
+                    np.asarray(getattr(per[t], name)),
+                    np.asarray(getattr(solo_flags[t], name)),
+                ):
+                    raise RuntimeError(
+                        f"tenant-plane parity FAILED: tenant {t} leaf "
+                        f"{name} differs between the stacked kernel and "
+                        "the solo run at identical streams"
+                    )
+        detections = int(
+            sum((np.asarray(f.change_global) >= 0).sum() for f in per)
+        )
+        sfx = f"_t{tcount}"
+        out.update(
+            {
+                f"tenant_agg_rows_per_sec{sfx}": round(
+                    rows_total / multi_s, 1
+                ),
+                f"tenant_seq_rows_per_sec{sfx}": round(
+                    rows_total / seq_s, 1
+                ),
+                f"tenant_speedup{sfx}": round(seq_s / multi_s, 3),
+                f"tenant_rows{sfx}": rows_total,
+                f"tenant_multi_time_s{sfx}": round(multi_s, 4),
+                f"tenant_seq_time_s{sfx}": round(seq_s, 4),
+                f"tenant_detections{sfx}": detections,
+                f"tenant_flags_match{sfx}": True,  # a mismatch raised above
+            }
+        )
+    return out
+
+
+def tenants_bench(counts, rows_per_class: int) -> None:
+    """--tenants mode: print the tenant-plane stats as the one JSON line."""
+    import jax
+
+    _enable_compile_cache(jax)
+    stats = _tenant_stats(tuple(counts), rows_per_class)
+    print(
+        json.dumps(
+            {
+                "metric": "tenant_agg_rows_per_sec",
+                "unit": "rows/s",
+                "tenant_counts": list(counts),
+                **stats,
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------
 # Host-fed sustained benchmark (VERDICT r4 #6: the SURVEY §7 "host-feed
 # bandwidth" hard part, measured on hardware instead of argued).
 # --------------------------------------------------------------------------
@@ -601,6 +758,21 @@ def _headline_core(prep, reps: int = 15, stall_factor: float = 1.5) -> dict:
     stalled = [i for i, t in enumerate(times) if t > stall_factor * floor_t]
     clean = [t for i, t in enumerate(times) if i not in stalled]
     elapsed = float(np.median(clean))
+    if stalled:
+        # Top-level warning (satellite, ISSUE 9): r05 recorded 11/15 reps
+        # stalled — a headline whose provenance deserves a loud line on
+        # stderr, not just a buried stalled_reps field. The headline
+        # median (and every derived cell: value, detect_time_s,
+        # collect_share) already EXCLUDES the stalled repetitions; the
+        # raw per-rep lists keep them for the artifact's evidence trail.
+        print(
+            f"bench: WARNING: {len(stalled)}/{reps} timed repetitions "
+            f"stalled (>{stall_factor}x the fastest); headline is the "
+            f"median of the {len(clean)} clean reps"
+            + (" — CONTENDED, treat with suspicion"
+               if len(stalled) >= (reps + 1) // 2 else ""),
+            file=sys.stderr,
+        )
     detect_clean = [
         t for i, t in enumerate(phases["detect"]) if i not in stalled
     ]
@@ -696,6 +868,22 @@ def _headline_core(prep, reps: int = 15, stall_factor: float = 1.5) -> dict:
         "collect_overflow": bool(collect_info.get("overflow", False)),
         "collect_share": round(collect_share, 4),
         "phase_s": phases,
+        # Stall-filtered per-phase medians (satellite, ISSUE 9): phase_s
+        # keeps every repetition for the evidence trail, but a median over
+        # a contended invocation (r05: 11/15 stalled) describes the
+        # tunnel, not the code — these cells are what the perf CLI reads.
+        "phase_median_s": {
+            name: round(
+                float(
+                    np.median(
+                        [v for i, v in enumerate(vs) if i not in stalled]
+                        or vs
+                    )
+                ),
+                4,
+            )
+            for name, vs in phases.items()
+        },
         "phase_hist": reg.to_json(),
         "xla": xla,
         "rows": stream.num_rows,
@@ -711,7 +899,9 @@ def _headline_core(prep, reps: int = 15, stall_factor: float = 1.5) -> dict:
     }
 
 
-def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
+def _serve_stats(
+    rows: int = 20_000, rate: float = 0.0, tenants: int = 1
+) -> dict:
     """``--serve``: the online-serving SLO bench — an in-process daemon on
     a loopback socket, driven by the loadgen at ``rate`` rows/s (0 = as
     fast as the socket takes them).
@@ -741,6 +931,10 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         window=1,
         data_policy="quarantine",
         results_csv="",
+        # tenants > 1 exercises the multi-tenant admission path end to
+        # end: stacked [T·P, CB, B] chunk program, TENANT wire routing,
+        # per-tenant verdict attribution (loadgen deals round-robin).
+        tenants=max(int(tenants), 1),
         compile_cache_dir=_CLI["compile_cache_dir"]
         or os.path.join(_BENCH_DIR, ".jax_cache"),
     )
@@ -774,6 +968,7 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         lines[:warm_n],
         verdicts=banner["verdicts"],
         timeout=300,
+        tenants=cfg.tenants,
     )
     # Reset the row-latency histogram between warm-up and measurement:
     # the warm-up runs unpaced with backpressure, and its congested
@@ -792,6 +987,7 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
         verdicts=banner["verdicts"],
         timeout=600,
         stop=True,
+        tenants=cfg.tenants,
     )
     thread.join(timeout=120)
     # Live-registry percentiles (telemetry.trace): the daemon's own
@@ -804,6 +1000,7 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
     reg_p99 = hist_quantile(hist, 0.99, stage="total")
     return {
         "serve_rows": rep["rows_sent"],
+        "serve_tenants": cfg.tenants,
         "serve_rows_per_sec": rep["achieved_rows_per_sec"],
         "serve_target_rows_per_sec": rate or None,
         "serve_p50_ms": rep["p50_ms"],
@@ -822,7 +1019,7 @@ def _serve_stats(rows: int = 20_000, rate: float = 0.0) -> dict:
     }
 
 
-def serve_bench(rows: int, rate: float) -> None:
+def serve_bench(rows: int, rate: float, tenants: int = 1) -> None:
     import jax
 
     _enable_compile_cache(jax)
@@ -831,7 +1028,7 @@ def serve_bench(rows: int, rate: float) -> None:
             {
                 "metric": "serve_row_to_verdict",
                 "unit": "ms",
-                **_serve_stats(rows, rate),
+                **_serve_stats(rows, rate, tenants),
                 "device": str(jax.devices()[0].platform),
             }
         )
@@ -1028,6 +1225,7 @@ if __name__ == "__main__":
     is_chunked = len(sys.argv) > 1 and sys.argv[1] == "--chunked"
     is_smoke = len(sys.argv) > 1 and sys.argv[1] == "--smoke"
     is_serve = len(sys.argv) > 1 and sys.argv[1] == "--serve"
+    is_tenants = len(sys.argv) > 1 and sys.argv[1] == "--tenants"
     try:
         if is_soak:
             soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
@@ -1039,6 +1237,21 @@ if __name__ == "__main__":
             serve_bench(
                 int(float(sys.argv[2])) if len(sys.argv) > 2 else 20_000,
                 float(sys.argv[3]) if len(sys.argv) > 3 else 0.0,
+                int(sys.argv[4]) if len(sys.argv) > 4 else 1,
+            )
+        elif is_tenants:
+            # --tenants [T1,T2,... [ROWS_PER_CLASS]] — default the ISSUE-9
+            # acceptance pair T∈{8,64}.
+            tenants_bench(
+                [
+                    int(x)
+                    for x in (
+                        sys.argv[2].split(",")
+                        if len(sys.argv) > 2
+                        else ("8", "64")
+                    )
+                ],
+                int(sys.argv[3]) if len(sys.argv) > 3 else 200,
             )
         else:
             main()
@@ -1053,6 +1266,8 @@ if __name__ == "__main__":
             metric = "chunked_rows_per_sec_chip"
         elif is_serve:
             metric = "serve_row_to_verdict"
+        elif is_tenants:
+            metric = "tenant_agg_rows_per_sec"
         print(
             json.dumps(
                 {

@@ -57,6 +57,15 @@ def run(cfg, stream=None):
     return _run(cfg, stream)
 
 
+def run_multi(cfg, streams=None):
+    """Execute a stacked multi-tenant run — T independent streams through
+    one compiled kernel (lazy import, same contract as :func:`run`; see
+    ``api.run_multi``)."""
+    from .api import run_multi as _run_multi
+
+    return _run_multi(cfg, streams)
+
+
 __all__ = [
     "DDMParams",
     "EDDMParams",
@@ -76,5 +85,6 @@ __all__ = [
     "ddm_step",
     "make_detector",
     "run",
+    "run_multi",
     "__version__",
 ]

@@ -78,7 +78,7 @@ _USAGE = (
     "usage: python -m distributed_drift_detection_tpu "
     "[--trace-dir DIR] [--profile-dir DIR] [--telemetry-dir DIR] "
     "[--data-policy strict|quarantine|repair] "
-    "[--compile-cache-dir DIR] [--collect compact|full] "
+    "[--compile-cache-dir DIR] [--collect compact|full] [--tenants N] "
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
     "       python -m distributed_drift_detection_tpu serve --features F --classes C [...]\n"
     "       python -m distributed_drift_detection_tpu loadgen SOURCE --port P [...]\n"
@@ -198,6 +198,14 @@ def main(argv: list[str]) -> None:
                 f"{'|'.join(COLLECT_MODES)}, got {collect!r})"
             )
         kw["collect"] = collect
+    tenants = _pop_flag(argv, "--tenants")
+    if tenants is not None:
+        try:
+            kw["tenants"] = int(tenants)
+        except ValueError as e:
+            raise SystemExit(f"{_USAGE}\n({e})") from None
+        if kw["tenants"] < 1:
+            raise SystemExit(f"{_USAGE}\n(--tenants must be >= 1)")
     if argv and len(argv) not in (6, 7):
         raise SystemExit(_USAGE)
     if argv:
@@ -215,10 +223,35 @@ def main(argv: list[str]) -> None:
         if len(argv) == 7:
             kw["dataset"] = argv[6]
 
-    from .api import run  # lazy: `report` above must not initialise jax
     from .config import RunConfig
 
-    res = run(RunConfig(**kw))
+    cfg = RunConfig(**kw)
+    if cfg.tenants > 1:
+        # Multi-tenant plane: ONE compiled kernel runs every tenant; the
+        # summary is per-tenant (each bit-identical to its solo run) plus
+        # the aggregate throughput the stacked dispatch buys.
+        from .api import run_multi
+
+        mr = run_multi(cfg)
+        for t, r in enumerate(mr.results):
+            m = r.metrics
+            print(
+                f"tenant={t} rows={r.stream.num_rows} "
+                f"detections={m.num_detections} "
+                f"mean_delay_rows={m.mean_delay_rows:.1f}"
+            )
+        print(
+            f"tenants={cfg.tenants} rows={mr.rows} "
+            f"final_time={mr.total_time:.3f}s "
+            f"agg_rows_per_sec={mr.agg_rows_per_sec:.1f}"
+        )
+        if mr.telemetry_path:
+            print(f"telemetry={mr.telemetry_path}")
+        return
+
+    from .api import run  # lazy: `report` above must not initialise jax
+
+    res = run(cfg)
     m = res.metrics
     print(
         f"rows={res.stream.num_rows} detections={m.num_detections} "

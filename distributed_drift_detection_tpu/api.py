@@ -28,6 +28,7 @@ collection + delay computation — not just the kernel.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from collections import OrderedDict
@@ -276,71 +277,41 @@ def _aot_warm_start(runner, batches, keys):
     }
 
 
-def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
-    """Load, stripe and compile-build a run without executing it."""
-    if cfg.collect not in COLLECT_MODES:
-        raise ValueError(
-            f"unknown collect mode {cfg.collect!r}; expected one of "
-            f"{COLLECT_MODES}"
-        )
-    if cfg.collect_capacity < 0:
-        # A negative value is truthy, so it would bypass the auto sizing
-        # and surface as an opaque trace error inside jnp.nonzero.
-        raise ValueError(
-            f"collect_capacity must be >= 0 (0 = auto), got "
-            f"{cfg.collect_capacity}"
-        )
-    if cfg.compile_cache_dir:
-        # Persistent XLA compilation cache (warm-start, tentpole c):
-        # enabled before any compile below so the runner build, the AOT
-        # warm-start AND the telemetry lowering hooks all hit it.
-        from .utils.compile_cache import enable_persistent_cache
+def _load_stream_for(cfg: RunConfig) -> StreamData:
+    """The config's stream, through the ingest contract (io.sanitize)."""
+    from .config import resolve_quarantine_path
 
-        enable_persistent_cache(cfg.compile_cache_dir)
-    if stream is None:
-        from .config import resolve_quarantine_path
+    # Ingest contract (io.sanitize): strict fails loudly on dirty
+    # rows, quarantine masks them (sidecar next to the run's other
+    # artifacts), repair imputes. The loader validates the policy
+    # name before any work.
+    return load_stream(
+        cfg.dataset,
+        cfg.mult_data,
+        seed=cfg.seed,
+        standardize=cfg.standardize,
+        data_policy=cfg.data_policy,
+        # repair quarantines what it cannot fix, so it writes the
+        # sidecar too; strict never drops a row, so it never needs one
+        quarantine_path=(
+            resolve_quarantine_path(cfg)
+            if cfg.data_policy in ("quarantine", "repair")
+            else None
+        ),
+    )
 
-        # Ingest contract (io.sanitize): strict fails loudly on dirty
-        # rows, quarantine masks them (sidecar next to the run's other
-        # artifacts), repair imputes. The loader validates the policy
-        # name before any work.
-        stream = load_stream(
-            cfg.dataset,
-            cfg.mult_data,
-            seed=cfg.seed,
-            standardize=cfg.standardize,
-            data_policy=cfg.data_policy,
-            # repair quarantines what it cannot fix, so it writes the
-            # sidecar too; strict never drops a row, so it never needs one
-            quarantine_path=(
-                resolve_quarantine_path(cfg)
-                if cfg.data_policy in ("quarantine", "repair")
-                else None
-            ),
-        )
-    if cfg.validate:
-        # Host-side ingest audit (utils.validate): valid rows must be
-        # finite with labels in 0..C-1 — the promotion of the in-jit
-        # checkify guards to a run-level switch. Cheap relative to the
-        # run; outside the Final Time span (prepare phase).
-        from .utils.validate import validate_stream
 
-        validate_stream(stream)
-    # Per-batch shuffle (C7 :187,190) is applied host-side at stripe time —
-    # each batch is visited once, so this is semantically identical to an
-    # in-loop shuffle but free on device (see io.stream.stripe_chunk).
-    # Streams synthesized by duplication keep a compressed (row table + index
-    # planes) form; ship that across the host→device link in its *packed*
-    # variant (row table + gather indices + 1-byte shuffle perms; the
-    # geometry planes are synthesized in-jit) — identical flags, ~30× less
-    # transfer than the materialized stream at mult=512 (~2.3× less than
-    # the round-1 indexed form).
-    # window == 0 → auto-size from the stream's planted drift spacing;
-    # window_rotations == 0 → auto depth (needs the resolved window first);
-    # ph.threshold == 0 → auto-tune λ from the same geometry.
-    # retrain_error_threshold auto (RETRAIN_AUTO): per-model-family guard
-    # resolution — config.resolve_retrain_threshold. Resolved first so the
-    # runner cache keys on what actually runs.
+def _resolve_policies(cfg: RunConfig, stream: StreamData) -> RunConfig:
+    """Resolve every auto policy against the stream's geometry.
+
+    window == 0 → auto-size from the stream's planted drift spacing;
+    window_rotations == 0 → auto depth (needs the resolved window first);
+    ph.threshold == 0 → auto-tune λ from the same geometry.
+    retrain_error_threshold auto (RETRAIN_AUTO): per-model-family guard
+    resolution — config.resolve_retrain_threshold. Resolved first so the
+    runner cache keys on what actually runs. Shared by :func:`prepare`
+    and :func:`prepare_multi` (per tenant) so the two paths cannot drift.
+    """
     cfg = replace(cfg, retrain_error_threshold=resolve_retrain_threshold(cfg))
     cfg = replace(cfg, window=auto_window(cfg, stream.dist_between_changes))
     cfg = replace(
@@ -354,19 +325,32 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
                 threshold=auto_ph_threshold(cfg, stream.dist_between_changes)
             ),
         )
-    # Quarantine-masked streams ride the dense striper: the packed form
-    # synthesizes `valid` from pure geometry in-jit, and a row mask is
-    # data, not geometry (flags are bit-identical across stripers).
-    indexed = (
-        stream.src is not None and cfg.window > 1
-        and not stream.has_masked_rows
-    )
-    striper = stripe_partitions_packed if indexed else stripe_partitions
-    batches = striper(
-        stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
-    )
-    spec = ModelSpec(stream.num_features, stream.num_classes)
-    model = build_model(cfg.model, spec, cfg)
+    return cfg
+
+
+def _check_collect_config(cfg: RunConfig) -> None:
+    if cfg.collect not in COLLECT_MODES:
+        raise ValueError(
+            f"unknown collect mode {cfg.collect!r}; expected one of "
+            f"{COLLECT_MODES}"
+        )
+    if cfg.collect_capacity < 0:
+        # A negative value is truthy, so it would bypass the auto sizing
+        # and surface as an opaque trace error inside jnp.nonzero.
+        raise ValueError(
+            f"collect_capacity must be >= 0 (0 = auto), got "
+            f"{cfg.collect_capacity}"
+        )
+
+
+def _build_runner(cfg: RunConfig, spec, model, nb: int, indexed: bool = False):
+    """Resolve the mesh width + compaction capacity and build (or fetch)
+    the compiled runner — the ONE copy of the device-selection and
+    capacity policy :func:`prepare` and :func:`prepare_multi` share, so
+    the solo and stacked paths cannot drift (the tenant plane's
+    bit-parity contract rides on them resolving identically). ``nb`` is
+    the per-partition microbatch count the compaction epilogue is sized
+    against (the stacked plane passes its NB_max)."""
     n_dev = cfg.mesh_devices or len(jax.devices())
     n_dev = min(n_dev, len(jax.devices()))
     if model.host_callback:
@@ -392,15 +376,66 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     # hatches — collect='full' and validate=True, whose structural audit
     # wants the plane the device produced, not a host reconstruction.
     if cfg.collect == "compact" and not cfg.validate:
-        _, nb = stripe_geometry(stream.num_rows, cfg.partitions, cfg.per_batch)
         capacity = cfg.collect_capacity or auto_compact_capacity(
             cfg.partitions, max(nb - 1, 1)
         )
     else:
         capacity = 0
-    runner, mesh, compile_info = _cached_runner(
+    return _cached_runner(
         cfg, spec, n_dev, indexed, model, compact_capacity=capacity
     )
+
+
+def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
+    """Load, stripe and compile-build a run without executing it."""
+    if cfg.tenants != 1:
+        raise ValueError(
+            f"prepare() is the single-stream path (tenants={cfg.tenants}); "
+            "use prepare_multi/run_multi for the stacked tenant plane"
+        )
+    _check_collect_config(cfg)
+    if cfg.compile_cache_dir:
+        # Persistent XLA compilation cache (warm-start, tentpole c):
+        # enabled before any compile below so the runner build, the AOT
+        # warm-start AND the telemetry lowering hooks all hit it.
+        from .utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(cfg.compile_cache_dir)
+    if stream is None:
+        stream = _load_stream_for(cfg)
+    if cfg.validate:
+        # Host-side ingest audit (utils.validate): valid rows must be
+        # finite with labels in 0..C-1 — the promotion of the in-jit
+        # checkify guards to a run-level switch. Cheap relative to the
+        # run; outside the Final Time span (prepare phase).
+        from .utils.validate import validate_stream
+
+        validate_stream(stream)
+    # Per-batch shuffle (C7 :187,190) is applied host-side at stripe time —
+    # each batch is visited once, so this is semantically identical to an
+    # in-loop shuffle but free on device (see io.stream.stripe_chunk).
+    # Streams synthesized by duplication keep a compressed (row table + index
+    # planes) form; ship that across the host→device link in its *packed*
+    # variant (row table + gather indices + 1-byte shuffle perms; the
+    # geometry planes are synthesized in-jit) — identical flags, ~30× less
+    # transfer than the materialized stream at mult=512 (~2.3× less than
+    # the round-1 indexed form).
+    cfg = _resolve_policies(cfg, stream)
+    # Quarantine-masked streams ride the dense striper: the packed form
+    # synthesizes `valid` from pure geometry in-jit, and a row mask is
+    # data, not geometry (flags are bit-identical across stripers).
+    indexed = (
+        stream.src is not None and cfg.window > 1
+        and not stream.has_masked_rows
+    )
+    striper = stripe_partitions_packed if indexed else stripe_partitions
+    batches = striper(
+        stream, cfg.partitions, cfg.per_batch, shuffle_seed=host_shuffle_seed(cfg)
+    )
+    spec = ModelSpec(stream.num_features, stream.num_classes)
+    model = build_model(cfg.model, spec, cfg)
+    _, nb = stripe_geometry(stream.num_rows, cfg.partitions, cfg.per_batch)
+    runner, mesh, compile_info = _build_runner(cfg, spec, model, nb, indexed)
     keys = jax.random.split(jax.random.key(cfg.seed), cfg.partitions)
     # AOT warm-start (tentpole c): host-callback models keep the lazy path
     # (their executables pin host state and are never cached anyway).
@@ -410,6 +445,342 @@ def prepare(cfg: RunConfig, stream: StreamData | None = None) -> PreparedRun:
     compile_info = {**compile_info, **aot_info}
     return PreparedRun(
         stream, batches, runner, keys, mesh, cfg, compile_info, exec_fn
+    )
+
+
+class PreparedMulti(NamedTuple):
+    """A stacked T-tenant run, ready to execute (see :func:`prepare_multi`).
+
+    ``batches``/``keys`` carry the ``[T·P, ...]`` tenant plane; ``config``
+    is the stacked-kernel config (``partitions = T·P``, ``tenants = T``)
+    the runner was built against, ``configs`` the per-tenant resolved solo
+    configs, ``nb_list`` each tenant's real microbatch count (its flag
+    width is ``nb_t − 1``; stacked columns beyond it are ragged padding).
+    """
+
+    streams: tuple
+    batches: object  # engine.Batches, [T·P, NB_max, B] stacked plane
+    runner: object
+    keys: jax.Array  # [T·P] per-(tenant, partition) PRNG keys
+    mesh: object
+    configs: tuple  # per-tenant resolved RunConfigs
+    config: RunConfig  # the stacked-kernel config (partitions = T·P)
+    nb_list: tuple  # per-tenant microbatch counts
+    compile_info: "dict | None" = None
+    exec_fn: "object | None" = None
+
+
+def _kernel_identity(cfg: RunConfig) -> tuple:
+    """The config fields that shape the compiled kernel — every tenant of
+    a stacked run must agree on these (streams/seeds may differ; the
+    kernel is one program). Mirrors ``_cached_runner``'s cache key minus
+    the per-run identity fields (seed/dataset ride the stream, not the
+    program)."""
+    return (
+        cfg.model, cfg.fit_steps, cfg.learning_rate, cfg.mlp_hidden,
+        cfg.mlp_learning_rate, cfg.forest_trees, cfg.forest_depth,
+        cfg.per_batch, cfg.partitions, cfg.ddm, cfg.window,
+        cfg.retrain_error_threshold, cfg.detector, cfg.ph, cfg.eddm,
+        cfg.hddm, cfg.hddm_w, cfg.adwin, cfg.kswin, cfg.stepd,
+        cfg.window_rotations, cfg.shuffle_batches, cfg.collect,
+        cfg.collect_capacity, cfg.validate, cfg.backend,
+        cfg.mesh_devices,
+    )
+
+
+def prepare_multi(
+    cfg: "RunConfig | list[RunConfig]", streams=None
+) -> PreparedMulti:
+    """Load, stripe, STACK and compile-build a T-tenant run.
+
+    The multi-tenant twin of :func:`prepare` (ROADMAP item 1): T
+    independent streams — each carrying its own detector + classifier
+    state — run through ONE compiled kernel whose leading axis is the
+    flattened ``(tenant, partition)`` plane. Per tenant: its stream loads
+    through the same ingest contract, its auto policies resolve against
+    its own geometry, and it stripes with its own shuffle seed — exactly
+    the solo run — then the T ``[P, NB_t, B]`` grids stack into one
+    ``[T·P, NB_max, B]`` plane (``engine.loop.stack_tenants``): ragged
+    tenant lengths become masked trailing microbatches absorbed by the
+    validity plane, so shapes stay static (zero recompiles across tenant
+    mixes of the same NB_max) and per-tenant flags are bit-identical to T
+    solo runs (tested). The kernel, sharding, compaction epilogue and AOT
+    warm-start are the single-stream ones — only the leading axis width
+    changed, which is why compile, dispatch and collect amortize across
+    the whole tenant plane (the aggregate-throughput win ``bench.py
+    --tenants`` measures).
+
+    ``cfg`` is either a ``tenants = T`` config (expanded via
+    ``config.tenant_configs``: tenant t gets ``seed + t`` and a
+    ``{tenant}``-substituted dataset) or an explicit list of solo configs
+    — which may differ in dataset/seed/mult_data (stream identity) but
+    must agree on everything that shapes the kernel
+    (:func:`_kernel_identity`; loudly checked). ``streams`` optionally
+    supplies pre-built per-tenant streams (None entries load from the
+    config). Multi-tenant runs always ride the dense striper — a tenant
+    plane is data, not geometry, exactly like the quarantine mask.
+    """
+    from .config import tenant_configs
+
+    if isinstance(cfg, RunConfig):
+        cfgs = tenant_configs(cfg)
+    else:
+        cfgs = list(cfg)
+        if not cfgs:
+            raise ValueError("prepare_multi needs at least one tenant config")
+        for i, c in enumerate(cfgs):
+            if c.tenants != 1:
+                raise ValueError(
+                    f"tenant config {i} has tenants={c.tenants}; explicit "
+                    "config lists must hold solo (tenants=1) configs"
+                )
+    tenants = len(cfgs)
+    _check_collect_config(cfgs[0])
+    if cfgs[0].compile_cache_dir:
+        from .utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(cfgs[0].compile_cache_dir)
+    if streams is None:
+        streams = [None] * tenants
+    if len(streams) != tenants:
+        raise ValueError(
+            f"{len(streams)} streams for {tenants} tenant configs"
+        )
+    resolved, loaded = [], []
+    for c, s in zip(cfgs, streams):
+        if s is None:
+            s = _load_stream_for(c)
+        if c.validate:
+            from .utils.validate import validate_stream
+
+            validate_stream(s)
+        if resolved:
+            # One kernel, ONE execution policy: AUTO knobs (window=0,
+            # window_rotations=0, ph.threshold=0) resolve against tenant
+            # 0's stream geometry and are pinned plane-wide — ragged
+            # tenants would otherwise auto-resolve different kernels from
+            # their own drift spacing and fail the identity check below.
+            # Each pin is guarded on the auto sentinel: an EXPLICIT
+            # per-tenant value must reach the identity check untouched
+            # (a disagreement there is a loud error, never silently
+            # overwritten). Per-tenant solo parity is against the
+            # RESOLVED configs (PreparedMulti.configs /
+            # MultiRunResult.results[t].config), which carry the pins.
+            c0 = resolved[0]
+            if not c.window:
+                c = replace(c, window=c0.window)
+            if not c.window_rotations:
+                c = replace(c, window_rotations=c0.window_rotations)
+            if c.detector == "ph" and not c.ph.threshold:
+                # Pin ONLY the auto λ — the tenant's other PH fields
+                # (delta/alpha/...) are explicit configuration and must
+                # reach the identity check untouched.
+                c = replace(
+                    c, ph=c.ph._replace(threshold=c0.ph.threshold)
+                )
+        resolved.append(_resolve_policies(c, s))
+        loaded.append(s)
+    ident0 = _kernel_identity(resolved[0])
+    spec0 = (loaded[0].num_features, loaded[0].num_classes)
+    for t in range(1, tenants):
+        if _kernel_identity(resolved[t]) != ident0:
+            raise ValueError(
+                f"tenant {t}'s resolved config shapes a different kernel "
+                "than tenant 0's (model/detector/geometry/window fields "
+                "must agree across the stacked plane; streams and seeds "
+                "may differ)"
+            )
+        spec_t = (loaded[t].num_features, loaded[t].num_classes)
+        if spec_t != spec0:
+            raise ValueError(
+                f"tenant {t}'s stream geometry {spec_t} (features, classes)"
+                f" disagrees with tenant 0's {spec0}; one kernel, one row "
+                "contract"
+            )
+    cfg0 = resolved[0]
+    p, b = cfg0.partitions, cfg0.per_batch
+    batches_list, nb_list = [], []
+    for c, s in zip(resolved, loaded):
+        nb_list.append(stripe_geometry(s.num_rows, p, b)[1])
+        batches_list.append(
+            stripe_partitions(s, p, b, shuffle_seed=host_shuffle_seed(c))
+        )
+    from .engine.loop import stack_tenants
+
+    batches = stack_tenants(batches_list)
+    nb_max = int(batches.y.shape[1])
+    total = replace(cfg0, partitions=p * tenants, tenants=tenants)
+    spec = ModelSpec(loaded[0].num_features, loaded[0].num_classes)
+    model = build_model(total.model, spec, total)
+    runner, mesh, compile_info = _build_runner(total, spec, model, nb_max)
+    # Per-(tenant, partition) keys: tenant t's block is EXACTLY the solo
+    # run's key split — split(key(seed_t), P) — so the stacked kernel's
+    # per-slice PRNG streams match the solo runs bit-for-bit.
+    from .engine.loop import concat_keys
+
+    keys = concat_keys(
+        [
+            jax.random.split(jax.random.key(c.seed), p)
+            for c in resolved
+        ]
+    )
+    exec_fn, aot_info = None, {"aot_seconds": 0.0, "aot_cached": False}
+    if not model.host_callback:
+        exec_fn, aot_info = _aot_warm_start(runner, batches, keys)
+    compile_info = {**compile_info, **aot_info}
+    return PreparedMulti(
+        tuple(loaded), batches, runner, keys, mesh, tuple(resolved), total,
+        tuple(nb_list), compile_info, exec_fn,
+    )
+
+
+class MultiRunResult(NamedTuple):
+    """One stacked multi-tenant execution: per-tenant results + the shared
+    span. ``results[t]`` is tenant t's :class:`RunResult` — its flags,
+    vote and delay metrics are bit-identical to the solo run's; its
+    ``total_time`` is the SHARED stacked span (one kernel ran all
+    tenants), which is exactly the amortization being claimed."""
+
+    results: tuple  # per-tenant RunResult
+    total_time: float  # the one stacked Final-Time span
+    rows: int  # aggregate rows across tenants
+    agg_rows_per_sec: float
+    timings: dict
+    config: RunConfig  # the stacked-kernel config (partitions = T·P)
+    telemetry_path: "str | None" = None
+
+
+def run_multi(
+    cfg: "RunConfig | list[RunConfig]", streams=None
+) -> MultiRunResult:
+    """Execute a stacked T-tenant run (see :func:`prepare_multi`).
+
+    One upload, one kernel dispatch, one collect for the whole tenant
+    plane; flags are split per tenant host-side
+    (``parallel.mesh.split_tenant_flags`` — free slicing of the one
+    collected table, O(detections) per tenant under compaction), the
+    drift vote and delay metrics are computed per tenant, and per-tenant
+    results-CSV rows are appended under each tenant's own config. With
+    ``telemetry_dir`` set on tenant 0's config the run emits one
+    run_started/run_completed pair (config payload carries ``tenants``)
+    and registers in the directory's index.jsonl like every other run.
+    """
+    from .parallel.mesh import split_tenant_flags, tenant_drift_vote
+
+    timer = PhaseTimer()
+    if isinstance(cfg, RunConfig):
+        bracket_cfg, t_count = cfg, max(int(cfg.tenants), 1)
+    else:
+        if not cfg:
+            raise ValueError("run_multi needs at least one tenant config")
+        bracket_cfg, t_count = cfg[0], len(cfg)
+    if bracket_cfg.backend != "jax":
+        raise ValueError(
+            f"unknown backend {bracket_cfg.backend!r}; expected 'jax' "
+            "(backend='spark' is retired — see api.run)"
+        )
+
+    # The run-lifecycle telemetry (open/run_started/registry/fail/close)
+    # is the shared _telemetry_bracket — one copy with _run_jax, opened
+    # BEFORE prepare so a prepare-time crash (bad dataset path, kernel
+    # disagreement) leaves the same failed-record evidence a solo run
+    # would. The payload carries the REQUESTED knob values + `tenants`
+    # (the documented digest contract: 0 = auto, resolved later), and the
+    # registry record rides kind="multi" so fleet tooling can tell the
+    # plane from a solo cell.
+    with _telemetry_bracket(
+        bracket_cfg,
+        telemetry_config_payload(replace(bracket_cfg, tenants=t_count)),
+        kind="multi",
+    ) as log:
+        if log is not None:
+            from .telemetry import registry as run_registry
+        with timer.phase("prepare"):
+            prep = prepare_multi(cfg, streams)
+        tenants = len(prep.configs)
+        cfg0 = prep.configs[0]
+        # --- the stacked Final-Time span: ONE upload, ONE dispatch, ONE
+        # collect for all T tenants — the amortization the tenant plane
+        # exists for. ---
+        start = time.perf_counter()
+        with timer.phase("upload"):
+            dev_batches, dev_keys = shard_batches(
+                prep.batches, prep.keys, prep.mesh
+            )
+        with timer.phase("detect"):
+            out = (prep.exec_fn or prep.runner)(dev_batches, dev_keys)
+            jax.block_until_ready(out)
+        with timer.phase("collect"):
+            flags_all, collect_info = host_flags(out)
+            per_tenant = split_tenant_flags(
+                flags_all, tenants, flag_cols=[nb - 1 for nb in prep.nb_list]
+            )
+            votes = [tenant_drift_vote(f) for f in per_tenant]
+            metrics = [
+                delay_metrics(
+                    f.change_global, s.dist_between_changes, c.per_batch
+                )
+                for f, s, c in zip(per_tenant, prep.streams, prep.configs)
+            ]
+        total_time = time.perf_counter() - start
+        # --- span ends ---
+
+        rows = sum(s.num_rows for s in prep.streams)
+        results = []
+        for t, (f, v, m, s, c) in enumerate(
+            zip(per_tenant, votes, metrics, prep.streams, prep.configs)
+        ):
+            if c.validate:
+                from .utils.validate import validate_flag_rows
+
+                validate_flag_rows(
+                    f, prep.nb_list[t], c.per_batch, s.num_rows
+                )
+            if c.results_csv:
+                a = (
+                    attribution_metrics(
+                        f.change_global, s.dist_between_changes, s.num_rows
+                    )
+                    if s.dist_between_changes > 0
+                    else None
+                )
+                append_result(
+                    c.results_csv,
+                    result_row(c, total_time, m, s.num_rows, attribution=a),
+                )
+            results.append(
+                RunResult(f, v, m, total_time, timer.as_dict(), s, c, None)
+            )
+        telemetry_path = None
+        if log is not None:
+            log.emit(
+                "run_completed",
+                rows=rows,
+                seconds=total_time,
+                detections=sum(m.num_detections for m in metrics),
+                rows_per_sec=rows / total_time if total_time > 0 else None,
+                tenants=tenants,
+                collect_mode=collect_info.get("mode"),
+                collect_overflow=bool(collect_info.get("overflow", False)),
+            )
+            run_registry.record(
+                cfg0.telemetry_dir,
+                log.run_id,
+                "completed",
+                rows=rows,
+                seconds=total_time,
+                detections=sum(m.num_detections for m in metrics),
+            )
+            telemetry_path = log.path
+
+    return MultiRunResult(
+        tuple(results),
+        total_time,
+        rows,
+        rows / total_time if total_time > 0 else 0.0,
+        timer.as_dict(),
+        prep.config,
+        telemetry_path,
     )
 
 
@@ -438,6 +809,12 @@ def prepare_chunked(
     persistent cache across daemon restarts. ``cfg.window`` must be
     explicit (the 0 = auto policy needs planted-drift geometry a live
     stream does not declare). Returns ``(detector, compile_info)``.
+
+    ``cfg.tenants > 1`` builds the stacked tenant plane — the streaming
+    twin of :func:`prepare_multi`: one ``[T·P, CB, B]`` chunk program
+    whose per-tenant state blocks are bit-identical to T solo detectors
+    (tenant seeds follow ``config.tenant_configs``: ``seed + t``); the
+    AOT warm-start compiles against the stacked geometry.
     """
     import numpy as _np
 
@@ -487,6 +864,7 @@ def prepare_chunked(
         ),
         rotations=cfg.window_rotations or 1,
         validate=validate,
+        tenants=cfg.tenants,
     )
     build_seconds = time.perf_counter() - t0
     example = stripe_chunk(
@@ -497,6 +875,12 @@ def prepare_chunked(
         cfg.per_batch,
         chunk_batches,
     )
+    if cfg.tenants > 1:
+        # The AOT warm-start must see the STACKED chunk geometry the
+        # tenant plane will actually feed ([T·P, CB, B]).
+        from .engine.loop import stack_tenants
+
+        example = stack_tenants([example] * cfg.tenants)
     info = {"cached": False, "build_seconds": build_seconds}
     if not model.host_callback:
         info.update(det.prepare(example))
@@ -516,7 +900,67 @@ class RunResult(NamedTuple):
     telemetry_path: "str | None" = None
 
 
+@contextlib.contextmanager
+def _telemetry_bracket(cfg: RunConfig, payload: dict, kind: "str | None" = None):
+    """The run-lifecycle telemetry bracket shared by :func:`_run_jax` and
+    :func:`run_multi` — one copy of the open/emit/record/fail/close
+    contract, so the batch and multi-tenant paths cannot drift.
+
+    On entry (telemetry enabled): open the run log, emit ``run_started``
+    with ``payload`` + host identity, and write the registry ``running``
+    record (``kind`` rides when given). Yields the log (None when
+    telemetry is off — no telemetry code runs at all). On an exception the
+    registry gets a best-effort ``failed`` record — the run's own
+    exception is the one that must surface, so a failing append (e.g. the
+    full volume that broke the run) is swallowed — and the log's fd is
+    released either way: the partial log is the crash evidence (lines are
+    flushed per emit), but a long-lived process catching per-run errors
+    must not leak a descriptor per failure. The happy path's ``completed``
+    record and final events stay with the caller (they carry run-shape-
+    specific payloads); callers may close the log early (close is
+    idempotent).
+    """
+    if not cfg.telemetry_dir:
+        yield None
+        return
+    from .parallel.multihost import host_identity
+    from .telemetry import registry as run_registry
+    from .telemetry.events import EventLog
+
+    ident = host_identity()
+    log = EventLog.open_run(
+        cfg.telemetry_dir,
+        name=cfg.resolved_app_name(),
+        process_index=ident["process_index"],
+    )
+    try:
+        log.emit("run_started", run_id=log.run_id, config=payload, **ident)
+        run_registry.record(
+            cfg.telemetry_dir,
+            log.run_id,
+            "running",
+            **({"kind": kind} if kind else {}),
+            config_digest=run_registry.config_digest(payload),
+            log=os.path.basename(log.path),
+            **ident,
+        )
+        yield log
+    except BaseException:
+        try:
+            run_registry.record(cfg.telemetry_dir, log.run_id, "failed")
+        except Exception:
+            pass
+        raise
+    finally:
+        log.close()
+
+
 def run(cfg: RunConfig, stream: StreamData | None = None) -> RunResult:
+    if cfg.tenants != 1:
+        raise ValueError(
+            f"run() is the single-stream path (tenants={cfg.tenants}); the "
+            "multi-tenant result is per-tenant structured — use run_multi"
+        )
     if cfg.backend == "spark":
         # Recorded decision (round 5; PARITY.md C3, README "Spark seam"):
         # the seam is retired, not stubbed — see the module docstring.
@@ -552,46 +996,14 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
     # segment + the run_started host-identity extras are what the correlate
     # CLI merges on), and registers it in the directory's index.jsonl so the
     # fleet view (which runs exist, did they finish) never requires parsing
-    # every log.
-    log = None
-    ident = None
-    if cfg.telemetry_dir:
-        from .parallel.multihost import host_identity
-        from .telemetry import registry as run_registry
-        from .telemetry.events import EventLog
-
-        ident = host_identity()
-        log = EventLog.open_run(
-            cfg.telemetry_dir,
-            name=cfg.resolved_app_name(),
-            process_index=ident["process_index"],
-        )
-
-    # try/finally, not context manager: a failed run (bad dataset path, flag
-    # audit rejection, full telemetry volume on the very first emit) must
-    # still release the log's fd — the partial log is the crash evidence
-    # (lines are flushed per emit), but a long-lived process catching
-    # per-run errors must not leak a descriptor per failure. The registry
-    # gets the matching terminal record either way: a crashed run reads as
-    # status=failed in index.jsonl, not as an unexplained absence.
-    try:
+    # every log. The open/emit/record/fail/close lifecycle is the shared
+    # _telemetry_bracket (one copy with run_multi); the payload is shared
+    # with resilience.heal — the heal planner recomputes these digests from
+    # a sweep spec, so the field set lives in one place
+    # (config.telemetry_config_payload).
+    with _telemetry_bracket(cfg, telemetry_config_payload(cfg)) as log:
         if log is not None:
-            # Shared with resilience.heal: the heal planner recomputes
-            # these digests from a sweep spec, so the field set lives in
-            # one place (config.telemetry_config_payload).
-            config_payload = telemetry_config_payload(cfg)
-            log.emit(
-                "run_started", run_id=log.run_id, config=config_payload,
-                **ident,
-            )
-            run_registry.record(
-                cfg.telemetry_dir,
-                log.run_id,
-                "running",
-                config_digest=run_registry.config_digest(config_payload),
-                log=os.path.basename(log.path),
-                **ident,
-            )
+            from .telemetry import registry as run_registry
         # Fault-injection site (resilience.faults; no-op unless armed):
         # a whole-run crash inside the registry bracket, so the failed
         # record + partial log land exactly as a real crash would leave
@@ -735,19 +1147,6 @@ def _run_jax(cfg: RunConfig, stream: StreamData | None) -> RunResult:
                 seconds=total_time,
                 detections=m.num_detections,
             )
-    except BaseException:
-        if log is not None:
-            try:
-                run_registry.record(cfg.telemetry_dir, log.run_id, "failed")
-            except Exception:
-                # Best-effort crash evidence: the volume that broke the run
-                # (e.g. full telemetry disk) may break this append too —
-                # the run's own exception is the one that must surface.
-                pass
-        raise
-    finally:
-        if log is not None:
-            log.close()  # idempotent; _finish_telemetry closes on success
 
     return RunResult(
         flags, vote, m, total_time, timer.as_dict(), stream, cfg,

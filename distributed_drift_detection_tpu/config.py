@@ -396,6 +396,18 @@ class RunConfig:
     # --- distribution (reference C8, DDM_Process.py:216-226) ---
     partitions: int = 8  # reference INSTANCES: row-striped stream partitions
     mesh_devices: int = 0  # 0 = all visible devices
+    # Multi-tenant stream plane (api.prepare_multi / api.run_multi): run N
+    # INDEPENDENT streams — each with its own detector + classifier state —
+    # through ONE compiled kernel by stacking their [P, NB, B] grids on the
+    # leading axis into [T·P, NB_max, B]. Tenant t's stream is the solo
+    # config with seed = seed + t and any '{tenant}' placeholder in
+    # `dataset` substituted (config.tenant_configs); ragged tenant lengths
+    # are absorbed by the validity plane (masked rows == padding inside
+    # jit — static shapes, zero recompiles), and per-tenant flags are
+    # bit-identical to N solo runs. 1 (default) = the classic single-stream
+    # path, byte-for-byte unchanged. `api.run` rejects tenants > 1 — the
+    # multi-tenant result is per-tenant structured (use run_multi).
+    tenants: int = 1
 
     # --- execution strategy ---
     # Speculative window width (engine.window): microbatches processed per
@@ -573,7 +585,45 @@ def telemetry_config_payload(cfg: RunConfig) -> dict:
     # whole completed sweep over a digest-schema change).
     if cfg.data_policy != "strict":
         payload["data_policy"] = str(cfg.data_policy)
+    # Same default-stays-out rule for the tenant count: a T-tenant run is a
+    # different experiment from a solo run, but pre-tenancy registries must
+    # keep matching their solo cells.
+    if cfg.tenants != 1:
+        payload["tenants"] = int(cfg.tenants)
     return payload
+
+
+def tenant_dataset(dataset: str, tenant: int) -> str:
+    """Tenant ``t``'s dataset spec: any ``{tenant}`` placeholder in the
+    configured dataset string is substituted with the tenant index, so one
+    config can fan out over per-tenant sources (e.g.
+    ``synth:rialto,seed={tenant},rows_per_class=4{tenant}`` gives every
+    tenant its own seed AND a ragged length). Without a placeholder every
+    tenant reads the same source (seeds still differ — see
+    :func:`tenant_configs`)."""
+    return dataset.replace("{tenant}", str(tenant))
+
+
+def tenant_configs(cfg: RunConfig) -> "list[RunConfig]":
+    """Expand a ``tenants = T`` config into the T solo configs it means.
+
+    Tenant ``t`` is the single-stream run with ``seed = cfg.seed + t``
+    (its own stream synthesis, PRNG keys and stripe-time shuffle) and
+    ``{tenant}``-substituted dataset — the exact runs
+    ``api.run_multi``'s per-tenant flags are bit-identical to. jax-free,
+    like the rest of this module, so CLIs can expand without a backend.
+    """
+    if cfg.tenants < 1:
+        raise ValueError(f"tenants must be >= 1, got {cfg.tenants}")
+    return [
+        replace(
+            cfg,
+            tenants=1,
+            seed=cfg.seed + t,
+            dataset=tenant_dataset(cfg.dataset, t),
+        )
+        for t in range(cfg.tenants)
+    ]
 
 
 # Version of the auto W×R resolution policy (auto_window / auto_rotations).

@@ -101,6 +101,8 @@ class ChunkedDetector:
         rotations: int = 1,
         validate: bool = False,
         donate: bool = True,
+        tenants: int = 1,
+        tenant_seeds=None,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -131,7 +133,36 @@ class ChunkedDetector:
             )
         self.retrain_error_threshold = retrain_error_threshold
         self.model = model
-        self.partitions = partitions
+        # Multi-tenant chunk plane (ROADMAP item 1, the streaming twin of
+        # api.prepare_multi): ``tenants = T`` runs T independent streams —
+        # each with its own detector + classifier state — through the one
+        # jitted chunk program by widening the leading axis to T·P. Chunks
+        # arrive pre-stacked (``engine.loop.stack_tenants`` /
+        # serve.admission.TenantMicroBatcher) as ``[T·P, CB, B]`` grids;
+        # tenant t's slice ``[t·P:(t+1)·P]`` of the carry IS the solo
+        # detector's carry: its PRNG keys derive from ``tenant_seeds[t]``
+        # (default ``seed + t`` — the solo convention of
+        # config.tenant_configs) exactly as a fresh solo detector's would,
+        # so per-tenant flags are bit-identical to T solo detectors fed
+        # the per-tenant chunks (tested). ``self.partitions`` stays the
+        # TOTAL leading-axis width (T·P) — every existing code path reads
+        # it as "the vmapped width"; ``tenant_partitions`` is the
+        # per-tenant P.
+        if tenants < 1:
+            raise ValueError(f"tenants must be >= 1, got {tenants}")
+        if tenant_seeds is not None and len(tenant_seeds) != tenants:
+            raise ValueError(
+                f"{len(tenant_seeds)} tenant_seeds for {tenants} tenants"
+            )
+        self.tenants = tenants
+        self.tenant_partitions = partitions
+        self.tenant_seeds = (
+            tuple(int(s) for s in tenant_seeds)
+            if tenant_seeds is not None
+            else tuple(seed + t for t in range(tenants))
+        )
+        self.partitions = partitions * tenants
+        partitions = self.partitions
         self._detector = resolve_detector(ddm_params, detector)
         if window == 0:
             raise ValueError(
@@ -237,7 +268,19 @@ class ChunkedDetector:
     # -- lifecycle -----------------------------------------------------------
 
     def _init_carry(self, first: Batches) -> LoopCarry:
-        keys = jax.random.split(jax.random.key(self._seed), self.partitions)
+        # Tenant t's key block is exactly the solo detector's
+        # split(key(seed_t), P) — one tenant (the default) reduces to the
+        # historical split(key(seed), P) bit-for-bit. concat_keys is the
+        # shared helper (engine.loop), same one prepare_multi uses.
+        from .loop import concat_keys
+
+        p = self.tenant_partitions
+        keys = concat_keys(
+            [
+                jax.random.split(jax.random.key(s), p)
+                for s in self.tenant_seeds
+            ]
+        )
         init_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
         params = jax.vmap(self.model.init)(init_keys[:, 1])
         return LoopCarry(
@@ -547,6 +590,88 @@ class ChunkedDetector:
             )
         return flags
 
+    # -- tenant plane --------------------------------------------------------
+
+    def tenant_flags(self, flags: FlagRows) -> "list[FlagRows]":
+        """Split a stacked ``[T·P, CB']`` flag table into per-tenant
+        ``[P, CB']`` views (``parallel.mesh.split_tenant_flags`` — free
+        host slicing; works on device arrays too)."""
+        from ..parallel.mesh import split_tenant_flags
+
+        return split_tenant_flags(flags, self.tenants)
+
+    def _tenant_span(self, tenant: int) -> "tuple[int, int]":
+        if not 0 <= tenant < self.tenants:
+            raise ValueError(
+                f"tenant {tenant} out of range (detector has {self.tenants})"
+            )
+        p = self.tenant_partitions
+        return tenant * p, (tenant + 1) * p
+
+    def tenant_carry(self, tenant: int) -> LoopCarry:
+        """Tenant t's slice of the carried state — structurally IDENTICAL
+        to a solo P-partition detector's carry (the per-tenant checkpoint
+        pytree of ROADMAP item 1)."""
+        assert self.carry is not None, "no state yet (feed or restore first)"
+        lo, hi = self._tenant_span(tenant)
+        return jax.tree.map(lambda x: x[lo:hi], self.carry)
+
+    def save_tenant(self, path: str, tenant: int) -> None:
+        """Checkpoint ONE tenant's detector state as a solo-shaped
+        checkpoint: a ``tenants=1`` detector (or a resized tenant plane)
+        can :meth:`restore` / :meth:`restore_tenant` it — tenants migrate
+        between planes without dragging the other T−1 states along."""
+        from ..utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            path,
+            self.tenant_carry(tenant),
+            meta={
+                "batches_done": self.batches_done,
+                "partitions": self.tenant_partitions,
+                "tenant": tenant,
+            },
+        )
+
+    def restore_tenant(self, path: str, tenant: int) -> dict:
+        """Load a solo-shaped checkpoint into tenant slot ``t`` of the
+        stacked carry (the inverse of :meth:`save_tenant`); the other
+        tenants' states are untouched. The detector must already hold a
+        carry (fed or restored) — slot surgery needs the plane to exist.
+        ``batches_done`` stays the plane's own (all tenants advance in
+        lock-step through the shared grid)."""
+        from ..utils.checkpoint import load_checkpoint
+
+        assert self.carry is not None, (
+            "restore_tenant needs an existing carry (feed or restore the "
+            "plane first)"
+        )
+        lo, hi = self._tenant_span(tenant)
+        template = jax.tree.map(lambda x: x[lo:hi], self.carry)
+        loaded, meta = load_checkpoint(path, template)
+        if int(meta.get("partitions", self.tenant_partitions)) != (
+            self.tenant_partitions
+        ):
+            raise ValueError(
+                f"checkpoint {path} holds {meta.get('partitions')} "
+                f"partitions; this plane's tenants carry "
+                f"{self.tenant_partitions}"
+            )
+
+        def scatter(leaf, sub):
+            # Typed PRNG keys scatter through their key data (portable
+            # across jax versions; .at[] on key arrays is not).
+            if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+                data = jax.random.key_data(leaf)
+                data = data.at[lo:hi].set(jax.random.key_data(sub))
+                return jax.random.wrap_key_data(
+                    data, impl=jax.random.key_impl(leaf)
+                )
+            return leaf.at[lo:hi].set(sub)
+
+        self.carry = jax.tree.map(scatter, self.carry, loaded)
+        return meta
+
     # -- checkpoint / resume (SURVEY.md §5) ----------------------------------
 
     def save(self, path: str) -> None:
@@ -559,6 +684,7 @@ class ChunkedDetector:
             meta={
                 "batches_done": self.batches_done,
                 "partitions": self.partitions,
+                **({"tenants": self.tenants} if self.tenants != 1 else {}),
             },
         )
 

@@ -113,6 +113,86 @@ def expand_packed(packed: PackedIndexedBatches) -> IndexedBatches:
     )
 
 
+def concat_keys(key_arrays):
+    """Concatenate typed PRNG key arrays along axis 0, via key data — the
+    portable route across jax versions (key arrays reject plain
+    ``np.asarray``/``concatenate``). The tenant plane's one key helper,
+    shared by ``api.prepare_multi`` and ``ChunkedDetector._init_carry`` so
+    the batch and streaming paths cannot diverge in exactly the code their
+    bit-parity contract rides on."""
+    import numpy as np
+
+    if len(key_arrays) == 1:
+        return key_arrays[0]
+    impl = jax.random.key_impl(key_arrays[0])
+    data = np.concatenate(
+        [np.asarray(jax.random.key_data(k)) for k in key_arrays]
+    )
+    return jax.random.wrap_key_data(jnp.asarray(data), impl=impl)
+
+
+def stack_tenants(batches_list) -> Batches:
+    """Stack T tenants' independent ``[P, NB_t, B]`` grids into ONE
+    ``[T·P, NB_max, B]`` plane — the multi-tenant leading axis.
+
+    The engines vmap over the leading axis with fully independent
+    per-slice state (model params, detector state, ``batch_a``, PRNG key),
+    so T tenants × P partitions stacked here run through one compiled
+    kernel exactly as T·P partitions would — one trace, one dispatch, one
+    collect — while every tenant keeps its own detector + classifier
+    state. Ragged tenant lengths (``NB_t < NB_max``) are padded with fully
+    masked microbatches (``valid=False``, ``rows=-1``, zero fill): inside
+    the scan a masked batch is inert (flags stay sentinel, the carry's
+    data is untouched), and because the padding sits strictly AFTER the
+    tenant's real batches it cannot perturb any real flag row — per-tenant
+    flags are bit-identical to the solo run (tested,
+    ``tests/test_tenancy.py``). Host-side (numpy) — the stacking happens
+    at stripe time, before the host→device upload.
+    """
+    import numpy as np
+
+    if not batches_list:
+        raise ValueError("stack_tenants needs at least one tenant grid")
+    b0 = batches_list[0]
+    p, b = b0.y.shape[0], b0.y.shape[2]
+    for i, bt in enumerate(batches_list):
+        if bt.y.shape[0] != p or bt.y.shape[2] != b:
+            raise ValueError(
+                f"tenant {i} grid {bt.y.shape} disagrees with tenant 0's "
+                f"partitions/per_batch ({p}, {b}); tenants share one kernel "
+                "geometry — only NB (stream length) may differ"
+            )
+    nb_max = max(bt.y.shape[1] for bt in batches_list)
+
+    def pad(bt: Batches) -> Batches:
+        extra = nb_max - bt.y.shape[1]
+        if not extra:
+            return bt
+        return Batches(
+            X=np.concatenate(
+                [bt.X, np.zeros((p, extra, b, bt.X.shape[3]), bt.X.dtype)],
+                axis=1,
+            ),
+            y=np.concatenate(
+                [bt.y, np.zeros((p, extra, b), bt.y.dtype)], axis=1
+            ),
+            rows=np.concatenate(
+                [bt.rows, np.full((p, extra, b), -1, bt.rows.dtype)], axis=1
+            ),
+            valid=np.concatenate(
+                [bt.valid, np.zeros((p, extra, b), bool)], axis=1
+            ),
+        )
+
+    padded = [pad(bt) for bt in batches_list]
+    return Batches(
+        *(
+            np.concatenate([getattr(bt, f) for bt in padded], axis=0)
+            for f in Batches._fields
+        )
+    )
+
+
 class FlagRows(NamedTuple):
     """Per-batch detection flags — reference output schema (−1 sentinels),
     plus ``forced_retrain`` marking fallback retrains (see

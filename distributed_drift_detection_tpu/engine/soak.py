@@ -188,6 +188,7 @@ def make_soak_runner(
     window: int = 1,
     chunk_batches: int = 0,
     rotations: int = 1,
+    tenants: int = 1,
 ):
     """Build ``run(key) -> SoakResult``: the full soak as ONE device program.
 
@@ -221,6 +222,15 @@ def make_soak_runner(
     drift-replay overhead: measured on one TPU chip at 1e8 rows,
     ``window=64`` runs ~0.6× the sequential engine's throughput. The
     benchmark therefore keeps ``window=1`` for the soak.
+
+    ``tenants > 1`` widens the plane to ``T·P`` independent streams in the
+    same single program (ROADMAP item 1): ``run(key)`` splits the key into
+    T tenant keys first, and tenant t's P-partition block generates and
+    detects exactly what a solo soak run with ``key =
+    jax.random.split(key, T)[t]`` would — flags ``[T·P, NB-1]`` slice
+    per-tenant bit-identically (tested). Total workload scales to
+    ``T·P·NB·B`` rows; partition-local row positions (and hence the int32
+    ceiling) are per-tenant, unchanged.
     """
     try:
         gen, default_f = _GENERATORS[generator]
@@ -332,16 +342,29 @@ def make_soak_runner(
             lambda x: x.reshape(num_chunks * cb, *x.shape[2:])[:nbf], flags
         )
 
-    sh = _mesh_sharding(model, mesh, p)
+    if tenants < 1:
+        raise ValueError(f"tenants must be >= 1, got {tenants}")
+    t_count = int(tenants)
+    sh = _mesh_sharding(model, mesh, p * t_count)
 
     def run(key: jax.Array) -> SoakResult:
-        keys = jax.random.split(key, p)
-        parts = jnp.arange(p)
+        if t_count == 1:
+            keys = jax.random.split(key, p)
+            parts = jnp.arange(p)
+        else:
+            # Tenant t's block == the solo soak run keyed by
+            # split(key, T)[t]: same per-partition keys, same
+            # partition-local offsets, bit-identical per-tenant flags.
+            tkeys = jax.random.split(key, t_count)
+            keys = jax.vmap(lambda k: jax.random.split(k, p))(
+                tkeys
+            ).reshape((t_count * p,))
+            parts = jnp.tile(jnp.arange(p), t_count)
         if sh is not None:
             keys = jax.lax.with_sharding_constraint(keys, sh)
             parts = jax.lax.with_sharding_constraint(parts, sh)
         flags = jax.vmap(run_partition)(parts, keys)
-        return SoakResult(flags=flags, rows_processed=p * nb * b)
+        return SoakResult(flags=flags, rows_processed=t_count * p * nb * b)
 
     if sh is not None:
         return jax.jit(

@@ -216,6 +216,54 @@ def host_flags(result: MeshRunResult) -> tuple[FlagRows, dict]:
     }
 
 
+def split_tenant_flags(
+    flags: FlagRows, tenants: int, flag_cols=None
+) -> "list[FlagRows]":
+    """Tenant-aware view of a stacked ``[T·P, NBF]`` flag plane: per-tenant
+    ``FlagRows`` slices ``[P, NBF]`` (or ``[P, flag_cols[t]]`` when the
+    per-tenant flag widths are given — ragged tenants' padded trailing
+    columns are pure sentinel and dropped).
+
+    This is the tenant half of the collect story: :func:`host_flags`
+    already ships the stacked plane as ONE device→host transfer —
+    O(detections) bytes under compaction, since the compacted table's
+    entries carry stacked-partition indices that decompose as
+    ``tenant = q // P`` — and this split is free host-side slicing, so a
+    T-tenant collect costs one transfer + O(detections) per tenant, never
+    T transfers. Works on host numpy or device arrays (pure indexing).
+    """
+    tp = flags.change_global.shape[0]
+    if tenants < 1 or tp % tenants:
+        raise ValueError(
+            f"stacked flag plane of {tp} rows does not split into "
+            f"{tenants} tenants"
+        )
+    p = tp // tenants
+    out = []
+    for t in range(tenants):
+        sl = FlagRows(
+            *(getattr(flags, f)[t * p : (t + 1) * p] for f in FlagRows._fields)
+        )
+        if flag_cols is not None:
+            w = int(flag_cols[t])
+            sl = FlagRows(*(leaf[:, :w] for leaf in sl))
+        out.append(sl)
+    return out
+
+
+def tenant_drift_vote(flags: FlagRows) -> np.ndarray:
+    """One tenant's cross-partition drift vote — the fraction of its
+    partitions flagging change per microbatch step, f32, matching the
+    device reduction's dtype and arithmetic (``finish_mesh_run``). The
+    multi-tenant collect computes this per tenant host-side: a vote pooled
+    across tenants would be meaningless (tenants are independent streams).
+    """
+    changed = (np.asarray(flags.change_global) >= 0).astype(np.float32)
+    return changed.sum(axis=0, dtype=np.float32) / np.float32(
+        changed.shape[0]
+    )
+
+
 def finish_mesh_run(
     flags: FlagRows, compact_capacity: int = 0
 ) -> MeshRunResult:

@@ -26,6 +26,7 @@ from __future__ import annotations
 _EXPORTS = {
     "AdmissionController": ".admission",
     "MicroBatcher": ".admission",
+    "TenantMicroBatcher": ".admission",
     "SealedChunk": ".admission",
     "IngressServer": ".ingress",
     "ServeRunner": ".runner",

@@ -50,6 +50,58 @@ from ..io.stream import stripe_chunk
 from ..resilience import faults
 
 
+def _split_buffered(bufs, n_take: int, num_features: int):
+    """One stream's buffered blocks → the seal's (take, rest) halves.
+
+    ``bufs`` is the ``(X_list, y_list, ok_list, ts_list)`` quadruple a
+    batcher accumulates per stream; the oldest ``n_take`` rows split off
+    as ``(take_X, take_y, take_ok, take_ts)`` (``take_ok`` collapses to
+    None when every taken row is valid) and the remainder is re-stashed
+    in the same list form. The ONE copy of the take/rest mechanics the
+    solo :class:`MicroBatcher` and per-tenant :class:`TenantMicroBatcher`
+    seals share — the serve path's bit-parity contract rides on these
+    exact semantics, so they must not be able to diverge. An empty
+    stream yields a zero-row take (``num_features`` shapes its plane).
+    """
+    X_list, y_list, ok_list, ts_list = bufs
+    if X_list:
+        X = np.concatenate(X_list) if len(X_list) > 1 else X_list[0]
+        y = np.concatenate(y_list) if len(y_list) > 1 else y_list[0]
+        ts = np.concatenate(ts_list) if len(ts_list) > 1 else ts_list[0]
+        ok = None
+        if any(o is not None for o in ok_list):
+            ok = np.concatenate(
+                [
+                    np.ones(len(a), bool) if o is None else o
+                    for a, o in zip(X_list, ok_list)
+                ]
+            )
+    else:
+        X = np.zeros((0, num_features), np.float32)
+        y = np.zeros((0,), np.int32)
+        ts = np.zeros((0,), np.float64)
+        ok = None
+    take_X, rest_X = X[:n_take], X[n_take:]
+    take_y, rest_y = y[:n_take], y[n_take:]
+    take_ts, rest_ts = ts[:n_take], ts[n_take:]
+    take_ok = rest_ok = None
+    if ok is not None:
+        take_ok, rest_ok = ok[:n_take], ok[n_take:]
+        if take_ok.all():
+            take_ok = None
+    rest = (
+        [rest_X] if len(rest_X) else [],
+        [rest_y] if len(rest_X) else [],
+        (
+            [rest_ok]
+            if len(rest_X) and rest_ok is not None
+            else ([None] if len(rest_X) else [])
+        ),
+        [rest_ts] if len(rest_X) else [],
+    )
+    return (take_X, take_y, take_ok, take_ts), rest
+
+
 class SealedChunk(NamedTuple):
     """One flushed microbatch: the striped ``[P, CB, B]`` chunk plus its
     accounting meta (``chunk`` index, ``start_row`` grid position,
@@ -203,25 +255,12 @@ class MicroBatcher:
                 self._cv.wait(max(wait, 0.001))
 
     def _seal_locked(self, n_take: int) -> None:
-        X = np.concatenate(self._X) if len(self._X) > 1 else self._X[0]
-        y = np.concatenate(self._y) if len(self._y) > 1 else self._y[0]
-        ts = np.concatenate(self._ts) if len(self._ts) > 1 else self._ts[0]
-        ok = None
-        if any(o is not None for o in self._ok):
-            ok = np.concatenate(
-                [
-                    np.ones(len(a), bool) if o is None else o
-                    for a, o in zip(self._X, self._ok)
-                ]
-            )
-        take_X, rest_X = X[:n_take], X[n_take:]
-        take_y, rest_y = y[:n_take], y[n_take:]
-        take_ts, rest_ts = ts[:n_take], ts[n_take:]
-        take_ok = rest_ok = None
-        if ok is not None:
-            take_ok, rest_ok = ok[:n_take], ok[n_take:]
-            if take_ok.all():
-                take_ok = None
+        take, rest = _split_buffered(
+            (self._X, self._y, self._ok, self._ts),
+            n_take,
+            self._X[0].shape[1],  # solo seals always hold data
+        )
+        take_X, take_y, take_ok, take_ts = take
         chunk = stripe_chunk(
             take_X,
             take_y,
@@ -252,14 +291,300 @@ class MicroBatcher:
         # reads as a grid with a masked tail, never as a re-packed stream.
         self.start_row += self.rows_per_chunk
         self.chunk_index += 1
-        self._X = [rest_X] if len(rest_X) else []
-        self._y = [rest_y] if len(rest_y) else []
-        self._ok = [rest_ok] if len(rest_X) and rest_ok is not None else (
-            [None] if len(rest_X) else []
-        )
-        self._ts = [rest_ts] if len(rest_X) else []
-        self._buffered = len(rest_X)
+        self._X, self._y, self._ok, self._ts = rest
+        self._buffered = len(rest[0][0]) if rest[0] else 0
         self._first_ts = time.monotonic() if self._buffered else None
+
+
+class _TenantSlot:
+    """The push surface one tenant's :class:`AdmissionController` sees:
+    routes admitted rows into its slot of the shared
+    :class:`TenantMicroBatcher` grid."""
+
+    def __init__(self, batcher: "TenantMicroBatcher", tenant: int):
+        self._batcher = batcher
+        self._tenant = tenant
+
+    def push(self, X, y, ok=None) -> None:
+        self._batcher.push(self._tenant, X, y, ok)
+
+
+class TenantMicroBatcher:
+    """T independent per-tenant row accumulators sealing into ONE stacked
+    ``[T·P, CB, B]`` grid — the serving half of the multi-tenant plane.
+
+    Each tenant accumulates its own arrival-order stream and stripes into
+    its own ``[P, CB, B]`` block with its own shuffle seed and its own
+    stream position (grid-slot semantics per tenant, exactly
+    :class:`MicroBatcher`'s); a seal stacks the T blocks on the leading
+    axis (``engine.loop.stack_tenants``) so the serve loop feeds one
+    chunk, one dispatch, for all tenants. Seal policy: a FULL grid seals
+    as soon as every tenant has a full span buffered (the balanced
+    sustained-load fast path — per-tenant content then equals T solo
+    batchers', so served flags stay bit-identical to solo runs); a
+    PARTIAL grid seals when the oldest buffered row has lingered past
+    ``linger_s`` — each tenant contributes what it has, masked through
+    the validity plane (ragged tenant traffic == ragged tenant lengths:
+    masked rows read as padding inside jit, static shapes, zero
+    recompiles). Every seal advances EVERY tenant's stream position by
+    the full span, so tenant blocks stay aligned to the stripe shuffle's
+    P·B invariant.
+
+    Liveness under skew: a tenant whose buffer crosses
+    ``max_buffer_spans`` spans forces a partial seal too (idle tenants
+    contribute masked blocks), so one hot tenant's buffering — and its
+    row latency — stays bounded even when the balanced full seal never
+    fires.
+
+    ``meta`` carries per-tenant accounting lists (``t_rows``,
+    ``t_rows_through``, ``t_start_row``) next to the pooled totals, so
+    the verdict sidecar can attribute per tenant
+    (``serve.runner._publish``) and the loadgen's per-tenant latency
+    mapping works. Interface-compatible with :class:`MicroBatcher` where
+    the serve loop touches it (get/flush/poison/poisoned/empty/depth/
+    rows_admitted); producers push via :meth:`push` with a tenant index
+    (the per-tenant :class:`_TenantSlot` adapters the admission
+    controllers hold).
+    """
+
+    def __init__(
+        self,
+        tenants: int,
+        partitions: int,
+        per_batch: int,
+        chunk_batches: int,
+        *,
+        num_features: int,
+        shuffle_seeds=None,  # per-tenant stripe seeds (None = unshuffled)
+        linger_s: float = 0.25,
+        start_rows=None,
+        chunk_index: int = 0,
+        rows_admitted=None,
+        max_queue: int = 64,
+        max_buffer_spans: int = 4,
+    ):
+        if tenants < 1:
+            raise ValueError(f"tenants must be >= 1, got {tenants}")
+        if max_buffer_spans < 1:
+            raise ValueError(
+                f"max_buffer_spans must be >= 1, got {max_buffer_spans}"
+            )
+        if num_features <= 0:
+            # An idle tenant's block is a zero-row stripe — its feature
+            # plane's width must be configuration, not inference.
+            raise ValueError(
+                f"num_features must be > 0, got {num_features}"
+            )
+        self.num_features = int(num_features)
+        self.tenants = tenants
+        self.partitions = partitions
+        self.per_batch = per_batch
+        self.chunk_batches = chunk_batches
+        # Per-TENANT span; the stacked chunk carries tenants· this.
+        self.rows_per_chunk = partitions * per_batch * chunk_batches
+        if shuffle_seeds is None:
+            shuffle_seeds = [None] * tenants
+        if len(shuffle_seeds) != tenants:
+            raise ValueError(
+                f"{len(shuffle_seeds)} shuffle_seeds for {tenants} tenants"
+            )
+        self.shuffle_seeds = list(shuffle_seeds)
+        self.linger_s = linger_s
+        self.start_rows = [
+            int(s) for s in (start_rows or [0] * tenants)
+        ]
+        if len(self.start_rows) != tenants:
+            raise ValueError(
+                f"{len(self.start_rows)} start_rows for {tenants} tenants"
+            )
+        self.chunk_index = int(chunk_index)
+        per_tenant_admitted = list(rows_admitted or [0] * tenants)
+        if len(per_tenant_admitted) != tenants:
+            raise ValueError(
+                f"{len(per_tenant_admitted)} rows_admitted for {tenants} "
+                "tenants"
+            )
+        self.tenant_rows_admitted = [int(r) for r in per_tenant_admitted]
+        self._max_buffer_spans = int(max_buffer_spans)
+        self._max_queue = max(1, max_queue)
+        self._cv = threading.Condition()
+        self._X = [[] for _ in range(tenants)]
+        self._y = [[] for _ in range(tenants)]
+        self._ok = [[] for _ in range(tenants)]
+        self._ts = [[] for _ in range(tenants)]
+        self._buffered = [0] * tenants
+        self._first_ts: "float | None" = None  # oldest buffered row, any tenant
+        self._queue: list[SealedChunk] = []
+        self._error: "BaseException | None" = None
+
+    # -- MicroBatcher-compatible surface -------------------------------------
+
+    @property
+    def rows_admitted(self) -> int:
+        return sum(self.tenant_rows_admitted)
+
+    def push(self, tenant: int, X, y, ok=None) -> None:
+        """Admit a block of rows into ``tenant``'s stream (arrival order =
+        that tenant's stream order). Blocks while the sealed queue is full
+        (backpressure to ingress), like :class:`MicroBatcher`."""
+        if not 0 <= tenant < self.tenants:
+            raise ValueError(
+                f"tenant {tenant} out of range 0..{self.tenants - 1}"
+            )
+        X = np.ascontiguousarray(X, np.float32)
+        y = np.ascontiguousarray(y, np.int32)
+        if len(X) == 0:
+            return
+        ingest_mono = time.monotonic()
+        with self._cv:
+            while len(self._queue) >= self._max_queue and self._error is None:
+                self._cv.wait(0.1)
+            if self._error is not None:
+                raise self._error
+            self._X[tenant].append(X)
+            self._y[tenant].append(y)
+            self._ok[tenant].append(None if ok is None else np.asarray(ok, bool))
+            self._ts[tenant].append(
+                np.full(len(X), ingest_mono, dtype=np.float64)
+            )
+            self._buffered[tenant] += len(X)
+            self.tenant_rows_admitted[tenant] += len(X)
+            if self._first_ts is None:
+                self._first_ts = time.monotonic()
+            while all(b >= self.rows_per_chunk for b in self._buffered):
+                self._seal_locked(full=True)
+            # Skew bound: under imbalanced traffic the all-tenants-full
+            # seal never fires, and without this a hot tenant's buffer
+            # (and its row latency) would grow without bound between
+            # linger seals. A tenant crossing max_buffer_spans spans
+            # forces a partial seal — idle tenants contribute masked
+            # blocks, trading their position density for the hot
+            # tenant's liveness, exactly like the linger deadline.
+            # Balanced sustained load never reaches it (the full seal
+            # above fires first), so the solo-parity fast path is
+            # untouched.
+            while (
+                self._buffered[tenant]
+                >= self._max_buffer_spans * self.rows_per_chunk
+            ):
+                self._seal_locked(full=False)
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        with self._cv:
+            # Seal until EVERY tenant's buffer is empty: a hot tenant may
+            # hold several spans (the skew bound allows up to
+            # max_buffer_spans), and the FLUSH/drain contract is "seal
+            # buffered rows NOW", not one-span-per-linger.
+            while any(self._buffered):
+                self._seal_locked(full=False)
+            self._cv.notify_all()
+
+    def poison(self, exc: BaseException) -> None:
+        with self._cv:
+            self._error = exc
+            self._cv.notify_all()
+
+    def empty(self) -> bool:
+        with self._cv:
+            return not self._queue and not any(self._buffered)
+
+    def poisoned(self) -> "BaseException | None":
+        with self._cv:
+            return self._error
+
+    def depth(self) -> dict:
+        with self._cv:
+            return {
+                "queued_chunks": len(self._queue),
+                "buffered_rows": sum(self._buffered),
+                "tenant_buffered_rows": list(self._buffered),
+            }
+
+    def get(self, timeout: float = 0.0) -> "SealedChunk | None":
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    raise self._error
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._cv.notify_all()
+                    return item
+                now = time.monotonic()
+                if (
+                    any(self._buffered)
+                    and self._first_ts is not None
+                    and now - self._first_ts >= self.linger_s
+                ):
+                    self._seal_locked(full=False)
+                    continue
+                waits = [deadline - now]
+                if any(self._buffered) and self._first_ts is not None:
+                    waits.append(self._first_ts + self.linger_s - now)
+                wait = min(waits)
+                if deadline - now <= 0:
+                    return None
+                self._cv.wait(max(wait, 0.001))
+
+    def _seal_locked(self, full: bool) -> None:
+        from ..engine.loop import stack_tenants
+
+        span = self.rows_per_chunk
+        blocks, ts_parts = [], []
+        t_rows, t_through, t_start = [], [], []
+        any_short = False
+        for t in range(self.tenants):
+            n_take = span if full else min(self._buffered[t], span)
+            take, rest = _split_buffered(
+                (self._X[t], self._y[t], self._ok[t], self._ts[t]),
+                n_take,
+                self.num_features,
+            )
+            take_X, take_y, take_ok, take_ts = take
+            blocks.append(
+                stripe_chunk(
+                    take_X,
+                    take_y,
+                    self.start_rows[t],
+                    self.partitions,
+                    self.per_batch,
+                    self.chunk_batches,
+                    self.shuffle_seeds[t],
+                    row_valid=take_ok,
+                )
+            )
+            ts_parts.append(take_ts)
+            taken_before = self.tenant_rows_admitted[t] - self._buffered[t]
+            t_rows.append(int(n_take))
+            t_through.append(int(taken_before + n_take))
+            t_start.append(self.start_rows[t])
+            any_short = any_short or n_take < span
+            # Grid-slot semantics PER TENANT: every tenant's position
+            # advances by the full span each seal, so blocks stay aligned.
+            self.start_rows[t] += span
+            self._X[t], self._y[t], self._ok[t], self._ts[t] = rest
+            self._buffered[t] = len(rest[0][0]) if rest[0] else 0
+        chunk = stack_tenants(blocks) if self.tenants > 1 else blocks[0]
+        meta = {
+            "chunk": self.chunk_index,
+            "start_row": t_start[0],
+            "rows": int(sum(t_rows)),
+            "rows_through": int(sum(t_through)),
+            "short": any_short,
+            "sealed_ts": time.time(),
+            "tenants": self.tenants,
+            "t_rows": t_rows,
+            "t_rows_through": t_through,
+            "t_start_row": t_start,
+            # row-tracing stamps: tenant-major concatenation, matching the
+            # stacked grid's leading-axis order
+            "ingest_mono": np.concatenate(ts_parts) if ts_parts else None,
+            "sealed_mono": time.monotonic(),
+        }
+        self._queue.append(SealedChunk(chunk, meta))
+        self.chunk_index += 1
+        self._first_ts = time.monotonic() if any(self._buffered) else None
 
 
 def _json_field(v) -> str:

@@ -6,6 +6,14 @@ The wire contract (newline-delimited UTF-8, one row per line):
   ``ServeParams.num_features``);
 * ``{"x": [v1, ..., vF], "y": label}`` or ``[v1, ..., vF, label]`` —
   JSON rows, normalized to the same fields at admission;
+* ``TENANT k`` — route this connection's subsequent rows to tenant slot
+  ``k`` of a multi-tenant daemon (``RunConfig.tenants > 1``; defaults to
+  tenant 0, so single-tenant clients never need it). A malformed or
+  out-of-range id is ordinary untrusted client input, not an internal
+  failure: the connection gets an ``ERR`` line and is dropped — the
+  daemon (and every other tenant's stream) keeps serving. Tenant
+  isolation is the multi-tenant plane's point; only genuine
+  admission-path failures poison the batcher;
 * ``FLUSH`` — seal the current partial microbatch now (clients use it to
   close out a replay instead of waiting for the linger deadline);
 * ``STOP`` — request a graceful drain (same path as SIGTERM: in-flight
@@ -34,24 +42,38 @@ import threading
 _RECV_BYTES = 1 << 16
 
 
+class _ProtocolReject(Exception):
+    """Connection-local protocol violation (e.g. a bad TENANT id): drop
+    THIS connection after the ERR reply, never the daemon."""
+
+
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self) -> None:
+        super().setup()
+        self._tenant = 0  # per-connection routing (the TENANT line)
+
     def handle(self) -> None:
         buf = b""
-        while True:
-            try:
-                data = self.request.recv(_RECV_BYTES)
-            except OSError:
-                break
-            if not data:
-                break
-            buf += data
-            cut = buf.rfind(b"\n")
-            if cut < 0:
-                continue
-            block, buf = buf[:cut], buf[cut + 1 :]
-            self._process(block.decode("utf-8", errors="replace").split("\n"))
-        if buf.strip():
-            self._process([buf.decode("utf-8", errors="replace")])
+        try:
+            while True:
+                try:
+                    data = self.request.recv(_RECV_BYTES)
+                except OSError:
+                    break
+                if not data:
+                    break
+                buf += data
+                cut = buf.rfind(b"\n")
+                if cut < 0:
+                    continue
+                block, buf = buf[:cut], buf[cut + 1 :]
+                self._process(
+                    block.decode("utf-8", errors="replace").split("\n")
+                )
+            if buf.strip():
+                self._process([buf.decode("utf-8", errors="replace")])
+        except _ProtocolReject:
+            pass  # ERR already sent; close just this connection
 
     def _process(self, lines: list[str]) -> None:
         server: "IngressServer" = self.server  # type: ignore[assignment]
@@ -60,7 +82,26 @@ class _Handler(socketserver.BaseRequestHandler):
             s = ln.strip()
             if not s:
                 continue
-            if s == "FLUSH":
+            if s.startswith("TENANT"):
+                # Any TENANT-prefixed line is a routing directive: no data
+                # row starts with it (CSV rows open with a digit/sign,
+                # JSON with {/[), so a malformed one ('TENANT', 'TENANT x')
+                # must reject loudly here — falling through as a dirty
+                # data row would leave every following row silently
+                # routed to the PREVIOUS tenant's slot. Admit what
+                # accumulated under the previous tenant first — blocks
+                # are per-tenant by construction.
+                self._admit(block)
+                block = []
+                try:
+                    self._tenant = server.check_tenant(int(s[6:].strip()))
+                except (ValueError, IndexError) as e:
+                    # Untrusted client input: reject THIS connection
+                    # (ERR + close), never the daemon — one client's
+                    # typo must not take down the other tenants.
+                    self._send(f"ERR {type(e).__name__}: {e}")
+                    raise _ProtocolReject from e
+            elif s == "FLUSH":
                 self._admit(block)
                 block = []
                 server.batcher.flush()
@@ -77,7 +118,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         server: "IngressServer" = self.server  # type: ignore[assignment]
         try:
-            res = server.admission.admit_lines(block)
+            res = server.admission_for(self._tenant).admit_lines(block)
         except BaseException as e:
             # The daemon must die loudly on an ingress-path failure (the
             # armed serve.ingress fault is the rehearsal): poison the
@@ -100,20 +141,35 @@ class IngressServer(socketserver.ThreadingTCPServer):
     """The listener: one daemon thread accepting, one per connection.
 
     ``on_stop`` is the runner's graceful-drain hook (the ``STOP``
-    protocol line); :attr:`batcher`/:attr:`admission` are shared with the
-    serve loop. ``server_address`` after construction carries the bound
-    port (``port=0`` requests an OS-assigned one).
+    protocol line); :attr:`batcher`/:attr:`admissions` are shared with
+    the serve loop. ``server_address`` after construction carries the
+    bound port (``port=0`` requests an OS-assigned one).
     """
 
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, host: str, port: int, admission, batcher, on_stop):
+    def __init__(self, host: str, port: int, admissions, batcher, on_stop):
         super().__init__((host, port), _Handler)
-        self.admission = admission
+        # One admission controller per tenant slot (the TENANT protocol
+        # line routes); a solo daemon passes a 1-element list.
+        self.admissions = list(admissions)
         self.batcher = batcher
         self.on_stop = on_stop
         self._thread: "threading.Thread | None" = None
+
+    def admission_for(self, tenant: int):
+        """The admission controller serving ``tenant`` (see TENANT line)."""
+        return self.admissions[tenant]
+
+    def check_tenant(self, tenant: int) -> int:
+        """Validate a TENANT line's id against the daemon's tenant plane."""
+        n = len(self.admissions)
+        if not 0 <= tenant < n:
+            raise ValueError(
+                f"TENANT {tenant} out of range (daemon serves {n} tenant(s))"
+            )
+        return tenant
 
     @property
     def port(self) -> int:

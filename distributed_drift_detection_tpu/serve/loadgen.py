@@ -146,6 +146,154 @@ def _send_rows(
     return send_ts
 
 
+def _run_loadgen_tenants(
+    host: str,
+    port: int,
+    lines: list[str],
+    tenants: int,
+    *,
+    rate: float = 0.0,
+    verdicts: "str | None" = None,
+    timeout: float = 60.0,
+    flush: bool = True,
+    stop: bool = False,
+    connect_timeout: float = 30.0,
+    expect_rows: "int | None" = None,
+    interleave: int = 64,
+) -> dict:
+    """Multi-tenant replay: the stream is dealt round-robin (blocks of
+    ``interleave`` rows) across T tenant slots over ONE connection, with
+    ``TENANT k`` protocol lines routing each block — the interleaved
+    traffic shape a real multi-tenant ingress sees. Latency attribution
+    is per tenant: a verdict record's ``tenants[k].rows_through`` maps
+    tenant k's sent rows exactly as ``rows_through`` does on a solo
+    daemon; the pooled per-row latencies feed one p50/p99 pair (the SLO
+    covers the plane, not one tenant)."""
+    # Deal lines into tenant streams (round-robin blocks) and build the
+    # wire segments: (tenant, [lines]) in send order.
+    streams: list[list[int]] = [[] for _ in range(tenants)]
+    segments: list[tuple[int, list[int]]] = []
+    for base in range(0, len(lines), interleave):
+        t = (base // interleave) % tenants
+        idx = list(range(base, min(base + interleave, len(lines))))
+        streams[t].extend(idx)
+        segments.append((t, idx))
+    tail = _VerdictTail(verdicts) if verdicts else None
+    baselines = [0] * tenants
+    if tail is not None:
+        for rec in tail.poll():
+            for ent in rec.get("tenants") or []:
+                k = int(ent["tenant"])
+                if k < tenants:
+                    baselines[k] = max(
+                        baselines[k], int(ent["rows_through"])
+                    )
+    sock = _connect(host, port, connect_timeout)
+    send_ts = np.empty(len(lines), np.float64)
+    sent_so_far = 0
+    try:
+        t0 = time.monotonic()
+        for t, idx in segments:
+            if rate > 0:
+                while sent_so_far > (time.monotonic() - t0) * rate:
+                    time.sleep(min(0.002, 1.0 / rate))
+            payload = (
+                f"TENANT {t}\n"
+                + "\n".join(lines[i] for i in idx)
+                + "\n"
+            )
+            sock.sendall(payload.encode())
+            send_ts[idx] = time.time()
+            sent_so_far += len(idx)
+        sent_span = time.monotonic() - t0
+        if flush:
+            sock.sendall(b"FLUSH\n")
+        if stop:
+            sock.sendall(b"STOP\n")
+    finally:
+        sock.close()
+    sent = len(lines)
+    expects = [b + len(s) for b, s in zip(baselines, streams)]
+    # expect_rows (same contract as the solo path): override how many
+    # TOTAL rows the verdict stream must cover before the probe stops
+    # waiting — e.g. a strict-policy replay whose rejected rows can never
+    # be covered.
+    expect_total = (
+        sum(baselines) + expect_rows if expect_rows is not None else None
+    )
+    records: list[dict] = []
+    covered = list(baselines)
+    timed_out = False
+
+    def _pending() -> bool:
+        if expect_total is not None:
+            return sum(covered) < expect_total
+        return any(c < e for c, e in zip(covered, expects))
+
+    if tail is not None:
+        deadline = time.monotonic() + timeout
+        while _pending():
+            fresh = tail.poll()
+            if fresh:
+                records.extend(fresh)
+                for rec in fresh:
+                    for ent in rec.get("tenants") or []:
+                        k = int(ent["tenant"])
+                        if k < tenants:
+                            covered[k] = max(
+                                covered[k], int(ent["rows_through"])
+                            )
+                continue
+            if time.monotonic() >= deadline:
+                timed_out = True
+                break
+            time.sleep(0.02)
+    lat_ms: list[float] = []
+    per_tenant_covered = [0] * tenants
+    if records:
+        for t in range(tenants):
+            entries = [
+                (int(e["rows_through"]), float(r["ts"]))
+                for r in records
+                for e in (r.get("tenants") or [])
+                if int(e["tenant"]) == t
+            ]
+            if not entries or not streams[t]:
+                continue
+            entries.sort()
+            throughs = np.array([x for x, _ in entries])
+            ts = np.array([x for _, x in entries])
+            pos = baselines[t] + np.arange(len(streams[t]))
+            idx = np.searchsorted(throughs, pos, side="right")
+            ok = idx < len(entries)
+            per_tenant_covered[t] = int(ok.sum())
+            row_ids = np.asarray(streams[t])[ok]
+            lat_ms.extend(
+                ((ts[idx[ok]] - send_ts[row_ids]) * 1000.0).tolist()
+            )
+    return {
+        "rows_sent": sent,
+        "rows_covered": len(lat_ms),
+        "tenants": tenants,
+        "tenant_rows_sent": [len(s) for s in streams],
+        "tenant_rows_covered": per_tenant_covered,
+        "verdicts": len(records),
+        "detections": sum(int(r["detections"]) for r in records),
+        "achieved_rows_per_sec": (
+            round(sent / sent_span, 1) if sent_span > 0 else None
+        ),
+        "target_rows_per_sec": rate or None,
+        "p50_ms": (
+            round(float(np.percentile(lat_ms, 50)), 2) if lat_ms else None
+        ),
+        "p99_ms": (
+            round(float(np.percentile(lat_ms, 99)), 2) if lat_ms else None
+        ),
+        "mean_ms": round(float(np.mean(lat_ms)), 2) if lat_ms else None,
+        "timeout": timed_out,
+    }
+
+
 def run_loadgen(
     host: str,
     port: int,
@@ -158,10 +306,21 @@ def run_loadgen(
     stop: bool = False,
     connect_timeout: float = 30.0,
     expect_rows: "int | None" = None,
+    tenants: int = 1,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
-    must cover before the probe stops waiting (default: all sent)."""
+    must cover before the probe stops waiting (default: all sent).
+    ``tenants > 1`` deals the stream round-robin across tenant slots of a
+    multi-tenant daemon (``TENANT`` protocol lines) with per-tenant
+    latency attribution — see :func:`_run_loadgen_tenants`."""
+    if tenants > 1:
+        return _run_loadgen_tenants(
+            host, port, lines, tenants,
+            rate=rate, verdicts=verdicts, timeout=timeout, flush=flush,
+            stop=stop, connect_timeout=connect_timeout,
+            expect_rows=expect_rows,
+        )
     tail = _VerdictTail(verdicts) if verdicts else None
     baseline = 0
     if tail is not None:
@@ -237,6 +396,10 @@ def main(argv=None) -> None:
                     help="cap the replay at N rows (default: the whole source)")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="target rows/s (0 = as fast as the socket takes them)")
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="deal the replay round-robin across N tenant "
+                    "slots of a multi-tenant daemon (TENANT wire lines, "
+                    "per-tenant latency attribution)")
     ap.add_argument("--dirty", action="append", default=[],
                     metavar="KIND[:ROWS[:SEED]]",
                     help="seeded dirty-row injection (nan_cell|bad_label|"
@@ -275,6 +438,7 @@ def main(argv=None) -> None:
         verdicts=verdicts,
         timeout=args.timeout,
         stop=args.stop,
+        tenants=args.tenants,
     )
     report.update(
         source=args.source,

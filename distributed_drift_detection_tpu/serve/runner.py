@@ -153,8 +153,18 @@ class ServeRunner:
         self._keep = [] if keep_flags else None
         self._max_chunks = max_chunks
         self.det = None
+        # Multi-tenant serving (RunConfig.tenants > 1): the detector is
+        # the stacked [T·P, CB, B] chunk program, the batcher the
+        # per-tenant TenantMicroBatcher, and `admissions` holds one
+        # AdmissionController per tenant (own running stats, own
+        # quarantine sidecar, shared counters) — the ingress TENANT line
+        # routes a connection's rows to its slot. `admission` stays the
+        # single controller on a solo daemon (tenant 0's otherwise) so
+        # existing drivers keep working.
+        self.tenants = max(int(cfg.tenants), 1)
         self.batcher: "MicroBatcher | None" = None
         self.admission: "AdmissionController | None" = None
+        self.admissions: "list[AdmissionController]" = []
         self._ingress = None
         self._log = None
         self._metrics = None
@@ -243,7 +253,18 @@ class ServeRunner:
                 cfg.per_batch,
                 params.chunk_batches,
             )
+            if self.tenants > 1:
+                from ..engine.loop import stack_tenants
+
+                example = stack_tenants([example] * self.tenants)
             resume = self.det.restore(params.checkpoint, example_chunk=example)
+            if int(resume.get("tenants", 1)) != self.tenants:
+                raise ValueError(
+                    f"checkpoint {params.checkpoint} holds "
+                    f"{resume.get('tenants', 1)} tenant(s); this daemon "
+                    f"serves {self.tenants} — tenant planes must match "
+                    "(migrate slots via ChunkedDetector.save_tenant)"
+                )
             self.det.rows_done = int(resume.get("rows_done", 0))
             self._flag_base = int(resume.get("flag_cols", 0))
             self._published = int(resume.get("chunk_index", 0))
@@ -263,28 +284,88 @@ class ServeRunner:
         self._verdict_fh = open(
             self.verdicts_path, "a" if resume is not None else "w"
         )
-        self.batcher = MicroBatcher(
-            cfg.partitions,
-            cfg.per_batch,
-            params.chunk_batches,
-            shuffle_seed=host_shuffle_seed(cfg),
-            linger_s=params.linger_s,
-            start_row=int(resume.get("stream_row", 0)) if resume else 0,
-            chunk_index=int(resume.get("chunk_index", 0)) if resume else 0,
-            rows_admitted=(
-                int(resume.get("rows_admitted", 0)) if resume else 0
-            ),
-        )
-        self.admission = AdmissionController(
-            self.batcher,
-            params.num_features,
-            params.num_classes,
-            policy=cfg.data_policy,
-            quarantine_path=(
-                cfg.quarantine_path or stem + ".quarantine.jsonl"
-            ),
-            metrics=self._metrics,
-        )
+        if self.tenants > 1:
+            from ..config import tenant_configs
+            from .admission import TenantMicroBatcher, _TenantSlot
+
+            tcfgs = tenant_configs(replace(cfg, tenants=self.tenants))
+            self.batcher = TenantMicroBatcher(
+                self.tenants,
+                cfg.partitions,
+                cfg.per_batch,
+                params.chunk_batches,
+                num_features=params.num_features,
+                # tenant t stripes with ITS solo shuffle seed (seed + t) —
+                # the bit-parity contract with t solo daemons/batch runs
+                shuffle_seeds=[host_shuffle_seed(c) for c in tcfgs],
+                linger_s=params.linger_s,
+                # Serve meta is optional, like the solo path's .get()s: a
+                # detector-plane checkpoint (ChunkedDetector.save carries
+                # `tenants` but no batcher accounting) resumes detector
+                # state with fresh positions, not a KeyError at startup.
+                start_rows=(
+                    [int(s) for s in resume["stream_rows"]]
+                    if resume and "stream_rows" in resume
+                    else None
+                ),
+                chunk_index=(
+                    int(resume.get("chunk_index", 0)) if resume else 0
+                ),
+                rows_admitted=(
+                    [int(r) for r in resume["t_rows_admitted"]]
+                    if resume and "t_rows_admitted" in resume
+                    else None
+                ),
+            )
+            def _tenant_qpath(t: int) -> str:
+                # Per-tenant sidecar: quarantine records must stay
+                # attributable to the tenant that shipped the row — an
+                # explicit path gets the same .t<k> suffix the derived
+                # stem does, never one interleaved file for the plane.
+                if cfg.quarantine_path:
+                    root, ext = os.path.splitext(cfg.quarantine_path)
+                    return f"{root}.t{t}{ext or '.jsonl'}"
+                return stem + f".t{t}.quarantine.jsonl"
+
+            self.admissions = [
+                AdmissionController(
+                    _TenantSlot(self.batcher, t),
+                    params.num_features,
+                    params.num_classes,
+                    policy=cfg.data_policy,
+                    quarantine_path=_tenant_qpath(t),
+                    metrics=self._metrics,
+                    source=f"ingress[t{t}]",
+                )
+                for t in range(self.tenants)
+            ]
+            self.admission = self.admissions[0]
+        else:
+            self.batcher = MicroBatcher(
+                cfg.partitions,
+                cfg.per_batch,
+                params.chunk_batches,
+                shuffle_seed=host_shuffle_seed(cfg),
+                linger_s=params.linger_s,
+                start_row=int(resume.get("stream_row", 0)) if resume else 0,
+                chunk_index=(
+                    int(resume.get("chunk_index", 0)) if resume else 0
+                ),
+                rows_admitted=(
+                    int(resume.get("rows_admitted", 0)) if resume else 0
+                ),
+            )
+            self.admission = AdmissionController(
+                self.batcher,
+                params.num_features,
+                params.num_classes,
+                policy=cfg.data_policy,
+                quarantine_path=(
+                    cfg.quarantine_path or stem + ".quarantine.jsonl"
+                ),
+                metrics=self._metrics,
+            )
+            self.admissions = [self.admission]
         if self._log is not None:
             from ..telemetry import registry as run_registry
 
@@ -324,7 +405,7 @@ class ServeRunner:
             self._ingress = IngressServer(
                 params.host,
                 params.port,
-                self.admission,
+                self.admissions,
                 self.batcher,
                 self.request_stop,
             )
@@ -353,6 +434,7 @@ class ServeRunner:
             self._ops.start()
         return {
             "serving": True,
+            "tenants": self.tenants,
             "host": params.host,
             "port": self._ingress.port if self._ingress is not None else None,
             "ops_port": self._ops.port if self._ops is not None else None,
@@ -377,6 +459,20 @@ class ServeRunner:
         """The live registry (ops scrape target; bench reads quantiles)."""
         return self._metrics
 
+    def _adm_totals(self) -> dict:
+        """Pooled admission accounting across the tenant plane (a solo
+        daemon's list holds its one controller)."""
+        out = {
+            "rows_seen": 0, "rows_quarantined": 0,
+            "rows_rejected": 0, "rows_repaired": 0,
+        }
+        for a in self.admissions:
+            out["rows_seen"] += a.rows_seen
+            out["rows_quarantined"] += a.rows_quarantined
+            out["rows_rejected"] += a.rows_rejected
+            out["rows_repaired"] += a.rows_repaired
+        return out
+
     def _slo_snapshot(self) -> dict:
         """Rule kind → current value (None = not measurable right now)."""
         from ..telemetry.trace import hist_quantile
@@ -392,9 +488,11 @@ class ServeRunner:
             # an idle daemon's last verdict ages by design.
             verdict_age = now - self._last_pub_mono
         quarantine_pct = None
-        adm = self.admission
-        if adm is not None and adm.rows_seen > 0:
-            quarantine_pct = 100.0 * adm.rows_quarantined / adm.rows_seen
+        adm = self._adm_totals() if self.admissions else None
+        if adm is not None and adm["rows_seen"] > 0:
+            quarantine_pct = (
+                100.0 * adm["rows_quarantined"] / adm["rows_seen"]
+            )
         # Loop liveness, not event age: works without a run log too (an
         # ops-only daemon must still tell wedged from idle), and any
         # wedge — device sync, publish, emit — blocks the loop thread.
@@ -430,7 +528,8 @@ class ServeRunner:
         from ..telemetry.trace import hist_quantile
 
         now = time.monotonic()
-        adm, batcher = self.admission, self.batcher
+        batcher = self.batcher
+        adm = self._adm_totals()
         p50 = hist_quantile(self._lat_hist, 0.5, stage="total")
         p99 = hist_quantile(self._lat_hist, 0.99, stage="total")
         return {
@@ -442,17 +541,16 @@ class ServeRunner:
                 else None
             ),
             "draining": self._stop.is_set(),
+            "tenants": self.tenants,
             "rows": {
-                "ingress_seen": adm.rows_seen if adm is not None else 0,
+                "ingress_seen": adm["rows_seen"],
                 "admitted": (
                     batcher.rows_admitted if batcher is not None else 0
                 ),
                 "published": self._rows_published,
-                "quarantined": (
-                    adm.rows_quarantined if adm is not None else 0
-                ),
-                "rejected": adm.rows_rejected if adm is not None else 0,
-                "repaired": adm.rows_repaired if adm is not None else 0,
+                "quarantined": adm["rows_quarantined"],
+                "rejected": adm["rows_rejected"],
+                "repaired": adm["rows_repaired"],
             },
             "chunks": {
                 "published": self._published,
@@ -570,6 +668,31 @@ class ServeRunner:
             "detections": int(changed.sum()),
             "changes": changes,
         }
+        if self.tenants > 1:
+            # Per-tenant verdict attribution: the top-level `changes` keep
+            # STACKED partition indices (tenant t's partitions are rows
+            # t·P..(t+1)·P−1 of the plane); each tenant entry re-indexes
+            # its own changes tenant-locally and carries its own
+            # rows/rows_through accounting — the loadgen's per-tenant
+            # latency attribution key.
+            p_per = cg.shape[0] // self.tenants
+            record["tenants"] = [
+                {
+                    "tenant": t,
+                    "rows": int(meta["t_rows"][t]),
+                    "rows_through": int(meta["t_rows_through"][t]),
+                    "start_row": int(meta["t_start_row"][t]),
+                    "detections": int(
+                        changed[t * p_per : (t + 1) * p_per].sum()
+                    ),
+                    "changes": [
+                        [int(p) - t * p_per, int(b), int(cg[p, b])]
+                        for p, b, _ in changes
+                        if t * p_per <= p < (t + 1) * p_per
+                    ],
+                }
+                for t in range(self.tenants)
+            ]
         line = json.dumps(record)
         # Fault-injection site (resilience.faults; no-op unless armed):
         # raise = die after the chunk's state advanced but before its
@@ -616,6 +739,18 @@ class ServeRunner:
         from ..utils.checkpoint import save_checkpoint
 
         meta = self._last_meta
+        extra = {}
+        if self.tenants > 1:
+            span = self.batcher.rows_per_chunk  # per-tenant grid span
+            extra = {
+                "tenants": self.tenants,
+                "stream_rows": [
+                    int(s) + span for s in meta["t_start_row"]
+                ],
+                "t_rows_admitted": [
+                    int(r) for r in meta["t_rows_through"]
+                ],
+            }
         save_checkpoint(
             self.params.checkpoint,
             self.det.carry,
@@ -632,6 +767,7 @@ class ServeRunner:
                 "flag_cols": self._flag_base,
                 "rows_done": self.det.rows_done,
                 "detections": self._detections,
+                **extra,
             },
         )
 
@@ -662,14 +798,16 @@ class ServeRunner:
             from ..telemetry import registry as run_registry
             from ..telemetry.metrics import write_exports
 
+            adm = self._adm_totals()
             self._log.emit(
                 "run_completed",
                 rows=self._rows_published,
                 seconds=elapsed,
                 detections=self._detections,
                 chunks=self._published,
-                rows_quarantined=self.admission.rows_quarantined,
-                rows_rejected=self.admission.rows_rejected,
+                rows_quarantined=adm["rows_quarantined"],
+                rows_rejected=adm["rows_rejected"],
+                **({"tenants": self.tenants} if self.tenants > 1 else {}),
             )
             run_registry.record(
                 self.cfg.telemetry_dir,
@@ -716,8 +854,8 @@ class ServeRunner:
     def _close_files(self) -> None:
         if self._verdict_fh is not None and not self._verdict_fh.closed:
             self._verdict_fh.close()
-        if self.admission is not None:
-            self.admission.close()
+        for adm in self.admissions:
+            adm.close()
 
     # -- test/bench surface --------------------------------------------------
 
@@ -751,6 +889,10 @@ def main(argv=None) -> None:
                     help="label domain size (labels must be 0..C-1)")
     ap.add_argument("--partitions", type=int, default=4)
     ap.add_argument("--per-batch", type=int, default=50)
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="independent tenant streams in one compiled "
+                    "kernel (wire: a TENANT k line routes a connection's "
+                    "rows; per-tenant verdict attribution in the sidecar)")
     ap.add_argument("--chunk-batches", type=int, default=4,
                     help="microbatches per flushed chunk ([P,CB,B] grid)")
     ap.add_argument("--window", type=int, default=1,
@@ -809,6 +951,7 @@ def main(argv=None) -> None:
         detector=args.detector,
         partitions=args.partitions,
         per_batch=args.per_batch,
+        tenants=args.tenants,
         window=args.window,
         seed=args.seed,
         shuffle_batches=not args.no_shuffle,

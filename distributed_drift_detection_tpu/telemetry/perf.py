@@ -77,6 +77,18 @@ CELLS = (
     ("soak_xl_value", _UP, True, "rows/s"),
     ("chunked_value", _UP, True, "rows/s"),
     ("chunked_overlap_efficiency", _UP, False, ""),
+    # Multi-tenant aggregate throughput (bench.py --tenants, r09+): the
+    # stacked-kernel rows/s at T∈{8,64} is GATED — amortizing dispatch/
+    # collect across the tenant plane is the tentpole's whole claim, and
+    # a regression here is a code property. The sequential baseline and
+    # the speedup ratio print informationally (the baseline moves with
+    # host load; the gated cell is the absolute aggregate rate).
+    ("tenant_agg_rows_per_sec_t8", _UP, True, "rows/s"),
+    ("tenant_agg_rows_per_sec_t64", _UP, True, "rows/s"),
+    ("tenant_seq_rows_per_sec_t8", _UP, False, "rows/s"),
+    ("tenant_seq_rows_per_sec_t64", _UP, False, "rows/s"),
+    ("tenant_speedup_t8", _UP, False, "x"),
+    ("tenant_speedup_t64", _UP, False, "x"),
     # Online-serving SLO (bench.py --serve, r07+). Throughput and p50
     # stay informational (they move with host load and the requested
     # replay rate), but p99 row→verdict latency is GATED (r08+): a
@@ -207,9 +219,30 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
     ):
         if comp.get(src) is not None:
             cells[dst] = float(comp[src])
+    # Phase medians are STALL-AWARE (satellite, ISSUE 9): r05's artifact
+    # had 11/15 reps stalled, so a raw median of phase_s described the
+    # contended tunnel, not the code. Prefer the artifact's own
+    # stall-filtered medians (phase_median_s, r09+); derive the same
+    # filtering from rep_times_s for older artifacts.
+    phase_med = bench.get("phase_median_s") or {}
     for name in ("upload", "collect"):
-        if phase_s.get(name):
-            cells[f"phase_{name}_s"] = float(statistics.median(phase_s[name]))
+        if phase_med.get(name) is not None:
+            cells[f"phase_{name}_s"] = float(phase_med[name])
+        elif phase_s.get(name):
+            vals = phase_s[name]
+            if rep and len(rep) == len(vals):
+                if stalled is None:
+                    _, stalled = _stall_split(rep)
+                clean_v = [
+                    v for i, v in enumerate(vals) if i not in stalled
+                ]
+                if clean_v and len(clean_v) < len(vals):
+                    vals = clean_v
+                    notes.append(
+                        f"phase_{name}_s derived from phase_s "
+                        "(non-stalled median)"
+                    )
+            cells[f"phase_{name}_s"] = float(statistics.median(vals))
 
     for k in (
         "collect_share",
@@ -217,6 +250,12 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "soak_xl_value",
         "chunked_value",
         "chunked_overlap_efficiency",
+        "tenant_agg_rows_per_sec_t8",
+        "tenant_agg_rows_per_sec_t64",
+        "tenant_seq_rows_per_sec_t8",
+        "tenant_seq_rows_per_sec_t64",
+        "tenant_speedup_t8",
+        "tenant_speedup_t64",
         "serve_rows_per_sec",
         "serve_p50_ms",
         "serve_p99_ms",
