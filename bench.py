@@ -94,7 +94,7 @@ _BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
 # cache (the warm-start CI runs two --smoke invocations against a shared
 # directory and asserts the second's compile split ≈ 0); --collect
 # pins the collect transport (compact|full) for A/B runs.
-_CLI = {"compile_cache_dir": "", "collect": ""}
+_CLI = {"compile_cache_dir": "", "collect": "", "ingest_workers": 0}
 
 
 # One argv-mutating flag parser for the whole project (the package CLI owns
@@ -529,36 +529,58 @@ def _ensure_chunked_file(path: str = CHUNKED_PATH) -> int:
     return total
 
 
-def _chunked_stats() -> dict:
-    """Drive the on-disk stream through native ingest → ChunkedDetector.
+def _chunked_stats(workers: "int | None" = None) -> dict:
+    """Drive the on-disk stream through the staged ingest pipeline →
+    ChunkedDetector.
 
-    Two measured passes over the same file:
-      * ``parse`` — drain ``io.feeder.csv_chunks`` alone (block reads +
-        native multithreaded parse + striping), no device: the host-feed
-        bandwidth ceiling.
-      * ``overlapped`` — the shipped pipeline: ``prefetch_chunks`` producer
-        thread + ``ChunkedDetector.feed`` with JAX async dispatch, so chunk
-        N+1 parses while chunk N computes.
+    Two measured passes over the same file, BOTH through the parallel
+    pipeline (``--ingest-workers``; 0 = auto):
+      * ``parse`` — drain ``io.feeder.csv_chunks`` alone (mmap'd
+        line-aligned blocks → parse worker pool → ordered sanitize →
+        pooled striper), no device: the host-feed bandwidth ceiling at
+        this worker count.
+      * ``overlapped`` — the shipped pipeline: the same feeder behind a
+        ``prefetch_chunks`` producer + ``ChunkedDetector.feed`` with JAX
+        async dispatch, so chunks parse/stripe while the device computes.
     ``overlap_efficiency = parse_time / overlapped_time`` → 1.0 means the
     device compute is fully hidden behind the feed (the SURVEY §7
-    double-buffering claim, measured); the headline is overlapped rows/s.
+    double-buffering claim, measured against the *pipeline's own* ceiling
+    — the per-stage breakdown below shows where that ceiling comes from).
 
-    Regime note (r05 captures): over the shared remote-TPU *tunnel* the
-    per-chunk h2d transfer (~22 MB) is the bottleneck — efficiency ~0.27,
-    transport-bound; the same code on a local device is parse-bound
-    (efficiency 0.73 on the CPU backend). Both regimes are the
-    measurement's point: ingest, not FLOPs, bounds this path.
+    ``pipeline_s`` is the per-stage busy breakdown of the overlapped pass
+    from the ingest pipeline gauges: read/parse (worker pool — sums
+    across workers, so it can exceed wall-clock), sanitize/stripe (the
+    ordered consumer), upload (feed/place dispatch), and ``feed_wait``
+    (consumer time blocked waiting on the host pipeline — the starvation
+    signal: ~0 means the device, not ingest, bounds the path).
+
+    Regime note (r05 captures, serial parser): over the shared remote-TPU
+    *tunnel* the per-chunk h2d transfer (~22 MB) was the bottleneck —
+    efficiency ~0.27, transport-bound; on a local device the path was
+    parse-bound at 0.374 overlap efficiency, which is what the r10
+    parallel pipeline attacks.
     """
     from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
     from distributed_drift_detection_tpu.io.feeder import (
         csv_chunks,
         prefetch_chunks,
+        resolve_ingest_workers,
+        stage_breakdown,
     )
     from distributed_drift_detection_tpu.models import ModelSpec, build_model
+    from distributed_drift_detection_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
 
+    workers = resolve_ingest_workers(
+        workers if workers is not None else _CLI["ingest_workers"]
+    )
     total_rows = _ensure_chunked_file()
     p, b, cb, window = 16, 100, 128, 128  # 204.8k-row chunks, W=128 spans
-    feeder = lambda: csv_chunks(CHUNKED_PATH, p, b, cb)  # noqa: E731
+
+    def feeder(metrics=None):
+        return csv_chunks(CHUNKED_PATH, p, b, cb, workers=workers,
+                          metrics=metrics)
 
     # Warm the page cache first so BOTH passes read the file warm — a
     # freshly written file would otherwise give pass 1 a cold-cache read
@@ -599,11 +621,21 @@ def _chunked_stats() -> dict:
     det.carry = None  # discard warm-up state; executables stay cached
     det.batches_done = 0
 
+    reg = MetricsRegistry()
     flags_async = []
     rows_done = 0
+    wait_s = feed_s = 0.0
+    it = iter(prefetch_chunks(feeder(metrics=reg), depth=2, metrics=reg))
     start = time.perf_counter()
-    for chunk in prefetch_chunks(feeder(), depth=2):
+    while True:
+        t0 = time.perf_counter()
+        chunk = next(it, None)
+        wait_s += time.perf_counter() - t0  # host pipeline starving the feed
+        if chunk is None:
+            break
+        t0 = time.perf_counter()
         flags_async.append(det.feed(chunk))
+        feed_s += time.perf_counter() - t0
         rows_done += int(chunk.valid.sum())  # numpy, no device sync
     np.asarray(flags_async[-1].change_global)  # final device sync
     overlapped_s = time.perf_counter() - start
@@ -611,6 +643,9 @@ def _chunked_stats() -> dict:
     detections = sum(
         int((np.asarray(f.change_global) >= 0).sum()) for f in flags_async
     )
+    pipeline_s = stage_breakdown(reg)
+    pipeline_s["upload"] = round(feed_s, 4)
+    pipeline_s["feed_wait"] = round(wait_s, 4)
 
     return {
         "value": round(overlapped_rate, 1),
@@ -626,6 +661,8 @@ def _chunked_stats() -> dict:
         # compute attached: → 1.0 means compute fully hidden behind the
         # feed (the SURVEY §7 double-buffering claim, measured).
         "overlap_efficiency": round(overlapped_rate / parse_rate, 3),
+        "ingest_workers": workers,
+        "pipeline_s": pipeline_s,
         "partitions": p,
         "chunk_batches": cb,
         "window": window,
@@ -1220,6 +1257,14 @@ if __name__ == "__main__":
                 f" got {_collect!r}"
             )
         _CLI["collect"] = _collect
+    _workers = _pop_flag(_argv, "--ingest-workers")
+    if _workers is not None:
+        try:
+            _CLI["ingest_workers"] = int(_workers)
+        except ValueError:
+            raise SystemExit(
+                f"bench.py: --ingest-workers must be an int, got {_workers!r}"
+            ) from None
     sys.argv = [sys.argv[0]] + _argv  # modes below read positionals from argv
     is_soak = len(sys.argv) > 1 and sys.argv[1] == "--soak"
     is_chunked = len(sys.argv) > 1 and sys.argv[1] == "--chunked"

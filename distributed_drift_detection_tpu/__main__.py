@@ -42,6 +42,15 @@ and checkpoints. ``loadgen`` replays an ``io/synth`` spec or CSV at a
 target rows/s (optionally with seeded dirty rows) and reports achieved
 rate + p50/p99 row→verdict latency as JSON.
 
+A ``chunked`` subcommand drives the streaming ingest pipeline end to end
+on a CSV (``harness.chunked_cli``): mmap'd line-aligned blocks fan out to
+``--ingest-workers`` parse workers, reassemble in order (bit-identical at
+any worker count), stripe through the pooled striper and feed the
+AOT-warmed chunked engine — the disk path the chunked benchmark measures,
+runnable on any file:
+
+    python -m distributed_drift_detection_tpu chunked stream.csv --classes 10 [...]
+
 Seven further subcommands work offline (no accelerator — ``doctor`` reads
 the data, the rest just the artifacts; ``heal --execute`` is the one that
 runs experiments):
@@ -88,7 +97,8 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu top DIR_OR_LOGS [--statusz URL]\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
     "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
-    "       python -m distributed_drift_detection_tpu doctor CSV [CSV ...]"
+    "       python -m distributed_drift_detection_tpu doctor [--jobs N] CSV [CSV ...]\n"
+    "       python -m distributed_drift_detection_tpu chunked CSV --classes C [...]"
 )
 
 
@@ -150,6 +160,13 @@ def main(argv: list[str]) -> None:
         from .io.sanitize import main as doctor_main
 
         doctor_main(argv[1:])
+        return
+    if argv and argv[0] == "chunked":
+        # Streaming ingest pipeline end to end on a CSV (harness.chunked_cli):
+        # parallel parse → stripe → AOT-warmed ChunkedDetector.
+        from .harness.chunked_cli import main as chunked_main
+
+        chunked_main(argv[1:])
         return
     if argv and argv[0] == "serve":
         # The always-on serving daemon (serve subsystem, docs/SERVING.md).

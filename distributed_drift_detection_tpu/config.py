@@ -457,6 +457,17 @@ class RunConfig:
     # overflow needs >12.5% of all slots flagged). Explicit values exist
     # for overflow tests and for streams known to flag densely.
     collect_capacity: int = 0
+    # Host-ingest parse fan-out of the streaming CSV path
+    # (io.feeder.csv_chunks ``workers=``; the `chunked` CLI's
+    # --ingest-workers and bench.py's flag of the same name). 0 = auto:
+    # one parse worker per core, capped at 4
+    # (io.feeder.resolve_ingest_workers). Blocks are parsed concurrently
+    # but reassembled in file order, so ANY worker count yields
+    # bit-identical chunks, flags and quarantine sidecars (pinned by test
+    # + the ingest-smoke CI job) — an execution knob, not experiment
+    # identity, so it stays out of the telemetry config digest like
+    # collect/compile_cache_dir.
+    ingest_workers: int = 0
     # Persistent XLA compilation cache directory ('' = off). When set,
     # compiled executables are cached across *processes* (jax
     # jax_compilation_cache_dir), so repeated sweep cells and restarted

@@ -542,22 +542,46 @@ class ChunkedDetector:
         out = []
         uncollected = 0  # trailing entries of `out` still device-resident
 
+        # Upload-stage accounting for the host-ingest pipeline gauges
+        # (io.feeder.StageClock's metric, stage="upload"): time spent
+        # dispatching place()/feed() — host-side dispatch cost only, the
+        # device work itself is async behind it.
+        c_stage = None
+        if metrics is not None:
+            from ..io.feeder import STAGE_BUSY_HELP, STAGE_BUSY_METRIC
+
+            c_stage = metrics.counter(STAGE_BUSY_METRIC, help=STAGE_BUSY_HELP)
+
         def _drain_group():
             nonlocal uncollected
             for j in range(len(out) - uncollected, len(out)):
                 out[j] = jax.tree.map(np.asarray, out[j])
             uncollected = 0
 
+        import time as _time
+
+        def _place_timed(chunk):
+            if chunk is None:
+                return None
+            t0 = _time.perf_counter()
+            placed = self.place(chunk)
+            if c_stage is not None:
+                c_stage.inc(_time.perf_counter() - t0, stage="upload")
+            return placed
+
         it = iter(chunks)
         nxt = next(it, None)
-        placed = self.place(nxt) if nxt is not None else None
+        placed = _place_timed(nxt)
         i = 0
         while placed is not None:
+            t_feed = _time.perf_counter()
             flags = self.feed(placed)
+            if c_stage is not None:
+                c_stage.inc(_time.perf_counter() - t_feed, stage="upload")
             # Double-buffer: dispatch chunk k+1's upload (and pay its host
             # parse/stripe cost) while chunk k computes.
             nxt = next(it, None)
-            placed = self.place(nxt) if nxt is not None else None
+            placed = _place_timed(nxt)
             if telemetry is not None:
                 flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
                 self.emit_heartbeat(telemetry)

@@ -12,11 +12,14 @@ _EXPORTS = {
     # datasets
     "convert_data_labels_to_csv": ".datasets",
     "rialto_fixture_csv": ".datasets",
+    # blocks (jax-free)
+    "line_block_ranges": ".blocks",
     # feeder
     "chunk_stream_arrays": ".feeder",
     "csv_chunks": ".feeder",
     "generator_chunks": ".feeder",
     "prefetch_chunks": ".feeder",
+    "resolve_ingest_workers": ".feeder",
     # sanitize (jax-free)
     "QuarantineReport": ".sanitize",
     "StreamContractError": ".sanitize",
@@ -24,6 +27,7 @@ _EXPORTS = {
     "read_quarantine": ".sanitize",
     "scan_csv": ".sanitize",
     # stream
+    "ChunkStriper": ".stream",
     "StreamData": ".stream",
     "load_csv": ".stream",
     "load_stream": ".stream",

@@ -176,21 +176,33 @@ def validate_header(
 def parse_rows(
     lines: list[str], num_columns: int
 ) -> tuple[np.ndarray, list[RowIssue]]:
-    """Tolerant row-by-row CSV parse: ``[n, num_columns]`` f32 + issues.
+    """Tolerant CSV parse: ``[n, num_columns]`` f32 + issues.
 
     The dirty-path complement of the fast parsers (``io.native`` /
     ``np.loadtxt``), which reject the whole file on one bad cell: here a
     ragged row becomes a row-level issue (its cells are NaN), a
     non-numeric cell becomes a cell-level issue (that cell is NaN), and
     everything parseable parses. Blank lines are skipped (matching
-    ``np.loadtxt``). Slower than the fast path by design — it only runs
-    when the fast path refused the data (or under fault injection).
+    ``np.loadtxt``).
+
+    Three vectorized tiers, coarsest first, so the per-cell Python loop
+    runs only over rows that actually contain a dirty cell: (1) every
+    rectangular row's fields convert in ONE ``np.asarray`` call — the
+    overwhelmingly common shape of a dirty *block* (a handful of bad rows
+    in thousands of clean ones) when only raggedness broke the fast path;
+    (2) on failure, per-row array conversion; (3) per-cell ``float`` for
+    the rows tier 2 refused. All tiers parse text → float64 → f32 (the
+    same correctly-rounded double parse, so a cell's value is identical
+    whichever tier lands it). Serve admission batches each recv-block
+    through here (``serve.admission``), so the ingress daemon rides the
+    same vectorization.
     """
     rows = [ln for ln in lines if ln.strip()]
     out = np.zeros((len(rows), num_columns), np.float32)
     issues: list[RowIssue] = []
-    for r, line in enumerate(rows):
-        fields = line.split(",")
+    split = [line.split(",") for line in rows]
+    rect: list[int] = []  # rows with the right field count
+    for r, fields in enumerate(split):
         if len(fields) != num_columns:
             issues.append(
                 RowIssue(
@@ -201,8 +213,11 @@ def parse_rows(
                 )
             )
             out[r] = np.nan
-            continue
-        for c, tok in enumerate(fields):
+        else:
+            rect.append(r)
+
+    def _cells(r: int) -> None:
+        for c, tok in enumerate(split[r]):
             try:
                 out[r, c] = float(tok)
             except ValueError:
@@ -216,6 +231,20 @@ def parse_rows(
                     )
                 )
                 out[r, c] = np.nan
+
+    if rect:
+        flat = [tok for r in rect for tok in split[r]]
+        try:
+            out[rect] = np.asarray(flat, np.float64).reshape(
+                len(rect), num_columns
+            )
+        except ValueError:
+            for r in rect:
+                try:
+                    out[r] = np.asarray(split[r], np.float64)
+                except ValueError:
+                    _cells(r)
+    issues.sort(key=lambda i: (i.row, -1 if i.column is None else i.column))
     return out, issues
 
 
@@ -276,24 +305,70 @@ def scan_matrix(
 
 
 def scan_csv(
-    path: str, target_column: str = "target"
+    path: str, target_column: str = "target", *, jobs: int = 1
 ) -> tuple[list[RowIssue], int]:
     """Full jax-free contract scan of a CSV: ``(issues, data_rows)``.
 
     The ``doctor`` CLI's engine — header validation raises, row/cell
     violations are returned. Always uses the tolerant parser (this is a
     diagnostic pass, not the hot ingest path).
+
+    ``jobs > 1`` splits the data region into that many line-aligned byte
+    ranges (the SAME splitter the parallel ingest pipeline uses —
+    ``io.blocks.line_block_ranges``) and scans them in a thread pool;
+    block results are rebased to absolute data-row indices and folded in
+    block order, so the returned issue list — and hence the doctor CLI's
+    printed violation order — is identical to the serial scan's (pinned
+    by test).
     """
+    jobs = max(1, int(jobs))
     with open(path) as fh:
         header = fh.readline().rstrip("\n").rstrip("\r").split(",")
         tcol = validate_header(header, target_column, path)
-        lines = fh.read().splitlines()
-    raw, issues = parse_rows(lines, len(header))
-    issues = issues + scan_matrix(
-        raw, tcol, header, flagged=frozenset(i.row for i in issues)
-    )
+        if jobs == 1:
+            lines = fh.read().splitlines()
+
+    def scan_lines(block_lines: list[str]) -> tuple[int, list[RowIssue]]:
+        raw, found = parse_rows(block_lines, len(header))
+        found = found + scan_matrix(
+            raw, tcol, header, flagged=frozenset(i.row for i in found)
+        )
+        return len(raw), found
+
+    if jobs == 1:
+        scanned = [scan_lines(lines)]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .blocks import line_block_ranges, open_mapped
+
+        fh, buf, data_start = open_mapped(path)
+        try:
+            span = len(buf) - data_start
+            block_bytes = max(1, -(-span // jobs))
+            ranges = line_block_ranges(buf, data_start, block_bytes)
+            with ThreadPoolExecutor(max_workers=jobs) as ex:
+                scanned = list(
+                    ex.map(
+                        lambda r: scan_lines(
+                            buf[r[0] : r[1]].decode().splitlines()
+                        ),
+                        ranges,
+                    )
+                )
+        finally:
+            close = getattr(buf, "close", None)
+            if close is not None:
+                close()
+            fh.close()
+
+    issues: list[RowIssue] = []
+    total = 0
+    for n_rows, found in scanned:  # block order == file order
+        issues.extend(i._replace(row=total + i.row) for i in found)
+        total += n_rows
     issues.sort(key=lambda i: (i.row, -1 if i.column is None else i.column))
-    return issues, len(raw)
+    return issues, total
 
 
 def mask_rows(
@@ -529,9 +604,11 @@ def apply_block_policy(
     :func:`apply_policy`. Issues carry block-local row indices;
     ``base_row`` rebases them to absolute data-row indices for the error
     and the sidecar. Returns ``(arr, ok | None)`` with quarantined rows
-    zeroed to the padding fill. ``repair`` is a whole-file policy (it
-    needs full-column statistics) and is rejected by the caller before
-    any block reaches here.
+    zeroed to the padding fill. Under ``policy='repair'`` the caller runs
+    :func:`repair_rows` first (streaming running-mean imputation — the
+    feeder and serve admission both do) and hands the *remaining*
+    unrepairable issues here, which fall through to the quarantine
+    branch below exactly like the whole-file repair's drop list.
     """
     if not issues:
         return arr, None
@@ -595,6 +672,38 @@ class RunningColumnStats:
     def means(self) -> np.ndarray:
         """Per-column finite means (f32); 0.0 where no evidence yet."""
         return (self._sum / np.maximum(self._count, 1)).astype(np.float32)
+
+
+def demote_unroundable_labels(
+    issues: list[RowIssue],
+    arr: np.ndarray,
+    tcol: int,
+    num_classes: "int | None",
+) -> list[RowIssue]:
+    """Label-domain guard for **streaming** repair (the serve-admission
+    clause, ``serve.admission``): flip a label-column repairable issue
+    (non-integral finite label) to unrepairable when rounding it could
+    leave the engine's ``0..C-1`` index domain — checked on the ROUNDED
+    value, exactly what repair would store. With ``num_classes`` None the
+    domain is unknowable, so every such label demotes: the one-shot
+    loader re-indexes labels after repair, a single-pass stream never
+    does, and a fabricated out-of-range class index must never reach the
+    engine. Feature-cell issues pass through untouched."""
+    with np.errstate(invalid="ignore"):
+        y_r = np.round(arr[:, tcol])
+    out = []
+    for i in issues:
+        if i.repairable and i.column == tcol:
+            in_domain = (
+                num_classes is not None
+                and np.isfinite(y_r[i.row])
+                and 0 <= y_r[i.row] < num_classes
+            )
+            if not in_domain:
+                out.append(i._replace(repairable=False))
+                continue
+        out.append(i)
+    return out
 
 
 def repair_rows(
@@ -747,6 +856,14 @@ def main(argv=None) -> None:
         default=20,
         help="violations printed per file (the count is always exact)",
     )
+    ap.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="parallel scan blocks per file (line-aligned byte ranges, "
+        "the ingest pipeline's splitter); violation output ordering is "
+        "identical to the serial scan (default: 1)",
+    )
     args = ap.parse_args(argv)
 
     dirty = False
@@ -755,7 +872,7 @@ def main(argv=None) -> None:
             print(f"{path}: synthetic spec, nothing to validate")
             continue
         try:
-            issues, n = scan_csv(path, args.target_column)
+            issues, n = scan_csv(path, args.target_column, jobs=args.jobs)
         except StreamContractError as e:
             print(f"{path}: {e}")
             dirty = True

@@ -378,6 +378,93 @@ def _pad(arr: np.ndarray, padded: int, fill) -> np.ndarray:
     return out
 
 
+class ChunkStriper:
+    """Allocation-pooled twin of :func:`stripe_chunk` for chunk-feed hot
+    loops (``io.feeder.csv_chunks``): same placement, same shuffle, same
+    validity folding — bit-identical output, pinned by test — but the pad
+    staging buffers are **reused across chunks** and the gather map is
+    cached when the stream is unshuffled (it is start-invariant then), so
+    a steady-state feed stripes with one gather and zero per-chunk staging
+    allocation instead of re-building concat + pad + map every time.
+
+    Not thread-safe by design: one striper belongs to one pipeline stage
+    (the feeder's sequential assembly loop). The *returned* ``Batches``
+    leaves are fresh gather outputs — handing them downstream while the
+    striper reuses its staging is safe.
+    """
+
+    def __init__(
+        self,
+        partitions: int,
+        per_batch: int,
+        chunk_batches: int,
+        shuffle_seed: int | None = None,
+        feature_dtype=np.float32,
+    ):
+        self.p, self.b, self.nb = partitions, per_batch, chunk_batches
+        self.shuffle_seed = shuffle_seed
+        self.feature_dtype = np.dtype(feature_dtype)
+        self.span = partitions * per_batch * chunk_batches
+        self._gmap: np.ndarray | None = None  # unshuffled: start-invariant
+        self._padX: np.ndarray | None = None  # [span, F] staging, pooled
+        self._pady = np.zeros(self.span, np.int32)
+
+    def _maps(self, n: int, start_row: int):
+        """(gmap, rows, valid) — exactly :func:`_stripe_maps`, with the
+        unshuffled gather map computed once and reused."""
+        assert self.shuffle_seed is None or start_row % (self.p * self.b) == 0, (
+            "stripe-time shuffle needs start_row aligned to "
+            "partitions*per_batch (all regular chunk boundaries are)"
+        )
+        if self.shuffle_seed is None:
+            if self._gmap is None:
+                self._gmap = _stripe_gmap(
+                    _stripe_perms(self.p, self.b, self.nb, None)
+                )
+            gmap = self._gmap
+        else:
+            gmap = _stripe_gmap(
+                _stripe_perms(
+                    self.p, self.b, self.nb, self.shuffle_seed,
+                    start_row // (self.p * self.b),
+                )
+            )
+        rows = (start_row + gmap).astype(np.int32)
+        return gmap, rows, gmap < n
+
+    def stripe(
+        self,
+        X: np.ndarray,
+        y: np.ndarray,
+        start_row: int,
+        row_valid: np.ndarray | None = None,
+    ) -> Batches:
+        """One span → ``[P, NB, B]`` chunk; :func:`stripe_chunk` semantics."""
+        n = len(y)
+        if n > self.span:
+            raise ValueError(f"span of {n} rows exceeds chunk grid {self.span}")
+        if row_valid is not None:
+            row_valid = np.asarray(row_valid, bool)
+            if row_valid.shape != (n,):
+                raise ValueError(
+                    f"row_valid shape {row_valid.shape} != span rows ({n},)"
+                )
+            X = np.where(row_valid[:, None], X, np.asarray(X).dtype.type(0))
+            y = np.where(row_valid, y, 0)
+        gmap, rows, valid = self._maps(n, start_row)
+        if row_valid is not None:
+            valid = valid & _pad(row_valid, self.span, False)[gmap]
+        X = np.asarray(X)
+        if self._padX is None or self._padX.shape[1] != X.shape[1]:
+            self._padX = np.zeros((self.span, X.shape[1]), self.feature_dtype)
+        padX, pady = self._padX, self._pady
+        padX[:n] = X  # casts to the transport dtype, like _pad(asarray(X))
+        padX[n:] = 0
+        pady[:n] = np.asarray(y, np.int32)
+        pady[n:] = 0
+        return Batches(X=padX[gmap], y=pady[gmap], rows=rows, valid=valid)
+
+
 def _stripe_maps(
     n: int, start_row: int, p: int, b: int, nb: int, shuffle_seed: int | None
 ):

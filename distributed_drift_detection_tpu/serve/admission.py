@@ -697,9 +697,14 @@ class AdmissionController:
     ) -> tuple[np.ndarray, list[sanitize.RowIssue]]:
         """Fast-path-then-fallback parse, the batch readers' shape: a
         vectorized parse serves the (overwhelmingly common) clean block;
-        the tolerant per-cell parser runs only when it refuses — ragged
-        rows, non-numeric text. NaN/Inf parse fine on the fast path and
-        are caught by the matrix scan like everywhere else."""
+        the tolerant parser runs only when it refuses — and that parser
+        is itself tier-vectorized (``sanitize.parse_rows``: whole-block →
+        per-row → per-cell), so a dirty block still parses its clean rows
+        in batched ``np.asarray`` calls rather than a per-cell Python
+        loop. Ingress hands whole recv-blocks here (``serve.ingress``),
+        which is what makes the batching real under load. NaN/Inf parse
+        fine on the fast path and are caught by the matrix scan like
+        everywhere else."""
         import io as _io
 
         try:

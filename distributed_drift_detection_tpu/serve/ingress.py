@@ -28,10 +28,12 @@ rejects rows from this connection's traffic.
 Handlers admit rows in *recv-sized blocks*: whatever complete lines one
 ``recv`` delivered go through ``AdmissionController.admit_lines`` as a
 single block, so sanitize cost amortizes under load while a trickling
-client still admits per line. An admission failure (an armed
-``serve.ingress`` fault, an unexpected bug) poisons the batcher — the
-serve loop re-raises it and the daemon dies loudly rather than serving
-around a broken ingress.
+client still admits per line — the admission parser is block-vectorized
+(``io.sanitize.parse_rows`` tiers), so bigger recv blocks parse at array
+speed, which is why ``_RECV_BYTES`` is generous. An admission failure
+(an armed ``serve.ingress`` fault, an unexpected bug) poisons the
+batcher — the serve loop re-raises it and the daemon dies loudly rather
+than serving around a broken ingress.
 """
 
 from __future__ import annotations
@@ -39,7 +41,10 @@ from __future__ import annotations
 import socketserver
 import threading
 
-_RECV_BYTES = 1 << 16
+# One recv per admission block: sized so a loaded ingress hands the
+# vectorized admission parse thousands of rows at a time (a ~100-byte row
+# → ~2.5k rows per block) instead of drip-feeding it.
+_RECV_BYTES = 1 << 18
 
 
 class _ProtocolReject(Exception):

@@ -75,8 +75,22 @@ CELLS = (
     ("collect_share", _DOWN, True, ""),
     ("soak_value", _UP, True, "rows/s"),
     ("soak_xl_value", _UP, True, "rows/s"),
+    # Host-ingest pipeline (r10+): the chunked headline, the parse-only
+    # feed ceiling, and the overlap ratio are ALL GATED — the parallel
+    # parse→stripe→upload pipeline's whole claim is feeding the device at
+    # ingest speed, and a regression in any of the three is a code
+    # property (stall-aware like every gate: contended artifacts report
+    # suspect, never fail). The per-stage busy cells below print
+    # informationally — they sum across workers and move with the host.
     ("chunked_value", _UP, True, "rows/s"),
-    ("chunked_overlap_efficiency", _UP, False, ""),
+    ("chunked_parse_rows_per_sec", _UP, True, "rows/s"),
+    ("chunked_overlap_efficiency", _UP, True, ""),
+    ("chunked_stage_read_s", _DOWN, False, "s"),
+    ("chunked_stage_parse_s", _DOWN, False, "s"),
+    ("chunked_stage_sanitize_s", _DOWN, False, "s"),
+    ("chunked_stage_stripe_s", _DOWN, False, "s"),
+    ("chunked_stage_upload_s", _DOWN, False, "s"),
+    ("chunked_feed_wait_s", _DOWN, False, "s"),
     # Multi-tenant aggregate throughput (bench.py --tenants, r09+): the
     # stacked-kernel rows/s at T∈{8,64} is GATED — amortizing dispatch/
     # collect across the tenant plane is the tentpole's whole claim, and
@@ -249,6 +263,7 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "soak_value",
         "soak_xl_value",
         "chunked_value",
+        "chunked_parse_rows_per_sec",
         "chunked_overlap_efficiency",
         "tenant_agg_rows_per_sec_t8",
         "tenant_agg_rows_per_sec_t64",
@@ -266,6 +281,14 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
     ):
         if bench.get(k) is not None:
             cells[k] = float(bench[k])
+    # Per-stage busy breakdown of the host-ingest pipeline (r10+):
+    # bench's chunked rider records `chunked_pipeline_s` as a dict.
+    pipe = bench.get("chunked_pipeline_s") or {}
+    for name in ("read", "parse", "sanitize", "stripe", "upload"):
+        if pipe.get(name) is not None:
+            cells[f"chunked_stage_{name}_s"] = float(pipe[name])
+    if pipe.get("feed_wait") is not None:
+        cells["chunked_feed_wait_s"] = float(pipe["feed_wait"])
     cvw = bench.get("cold_vs_warm_compile_s") or {}
     for src, dst in (
         ("cold_s", "compile_cold_s"),

@@ -23,6 +23,7 @@
 #include <charconv>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -37,6 +38,53 @@ struct Csv {
   int64_t cols = 0;
 };
 
+// Floating-point std::from_chars landed in libstdc++ 11 (the library
+// feature-test macro is only defined once the FP overloads exist —
+// gcc 10 ships the integer ones only). On older toolchains fall back to
+// strtod on a bounded NUL-terminated copy: glibc's strtod is correctly
+// rounded like from_chars, so parsed values are bit-identical; a field
+// longer than the copy buffer mis-consumes and fails the row (falls to
+// the NumPy path) rather than ever parsing wrong. Keeps TPU-host and
+// dev-container builds on one source.
+struct FpResult {
+  const char* ptr;
+  std::errc ec;
+};
+
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+inline FpResult parse_fp(const char* p, const char* end, double& v) {
+  auto [next, ec] = std::from_chars(p, end, v);
+  return {next, ec};
+}
+#else
+inline FpResult parse_fp(const char* p, const char* end, double& v) {
+  // strtod accepts a wider grammar than from_chars (leading whitespace,
+  // hex floats) and honors LC_NUMERIC. Reject those up front so both
+  // builds parse exactly the same language — cross-toolchain determinism
+  // of which rows are "malformed" matters as much as the values. (Python
+  // processes leave LC_NUMERIC in the C locale; nothing here calls
+  // setlocale.)
+  if (p < end && (*p == ' ' || *p == '\t' || *p == '\v' || *p == '\f' ||
+                  *p == '\r' || *p == '\n'))
+    return {p, std::errc::invalid_argument};
+  {
+    const char* q = p;
+    if (q < end && *q == '-') ++q;
+    if (q + 1 < end && q[0] == '0' && (q[1] == 'x' || q[1] == 'X'))
+      return {p, std::errc::invalid_argument};
+  }
+  char tmp[128];
+  size_t n = std::min<size_t>(static_cast<size_t>(end - p), sizeof(tmp) - 1);
+  std::memcpy(tmp, p, n);
+  tmp[n] = '\0';
+  char* endp = nullptr;
+  double parsed = std::strtod(tmp, &endp);
+  if (endp == tmp) return {p, std::errc::invalid_argument};
+  v = parsed;
+  return {p + (endp - tmp), std::errc()};
+}
+#endif
+
 // Parse one CSV line of `cols` floats into out[0..cols). Strict: returns
 // false on any malformed/missing/extra field.
 bool parse_line(const char* p, const char* end, float* out, int64_t cols) {
@@ -48,7 +96,7 @@ bool parse_line(const char* p, const char* end, float* out, int64_t cols) {
       if (p < end && (*p == '+' || *p == '-')) return false;  // "+-3.5"
     }
     double v = 0.0;
-    auto [next, ec] = std::from_chars(p, end, v);
+    auto [next, ec] = parse_fp(p, end, v);
     if (ec != std::errc() || next == p) return false;
     out[c++] = static_cast<float>(v);
     p = next;

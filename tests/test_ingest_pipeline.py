@@ -1,0 +1,577 @@
+"""Parallel host-ingest pipeline (ISSUE 10): bit-identity of the
+parse→stripe→upload path at any worker count, streaming repair, the
+pooled striper, the block planner, and doctor --jobs."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu.io import csv_chunks
+from distributed_drift_detection_tpu.io.blocks import line_block_ranges
+from distributed_drift_detection_tpu.io.sanitize import (
+    RunningColumnStats,
+    read_quarantine,
+    scan_csv,
+)
+
+
+def _write_csv(path, X, y, fmt=lambda v: repr(float(v))):
+    f = X.shape[1]
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(f)) + ",target\n")
+        for i in range(len(y)):
+            fh.write(
+                ",".join(fmt(v) for v in X[i]) + f",{int(y[i])}\n"
+            )
+
+
+def _dirty_csv(path, n=900, f=4, seed=7):
+    """Deterministic dirty stream: NaN cells, non-numeric cells, bad
+    labels, ragged rows — each kind straddling block edges at small
+    block_bytes."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        row = [repr(float(v)) for v in rng.normal(size=f)]
+        row.append(str(int(rng.integers(0, 5))))
+        if i % 83 == 3:
+            row[1] = "nan"
+        if i % 127 == 5:
+            row[0] = "junk"
+        if i % 149 == 7:
+            row[f] = "bad"
+        if i % 211 == 9:
+            row = row[:f]
+        lines.append(",".join(row))
+    with open(path, "w") as fh:
+        fh.write(",".join(f"f{i}" for i in range(f)) + ",target\n")
+        fh.write("\n".join(lines) + "\n")
+    return n, f
+
+
+def _chunks_equal(a, b):
+    assert len(a) == len(b)
+    for ca, cb in zip(a, b):
+        for name, la, lb in zip(ca._fields, ca, cb):
+            np.testing.assert_array_equal(
+                np.asarray(la), np.asarray(lb), err_msg=name
+            )
+
+
+# ---------------------------------------------------------------------------
+# Parallel parse == serial parse, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_parallel_chunks_bit_identical_clean(tmp_path):
+    """Clean stream: every worker count yields the serial path's chunks
+    exactly, including block edges straddling rows and the padded final
+    partial chunk."""
+    rng = np.random.default_rng(0)
+    n, f = 2357, 4  # not a multiple of any chunk geometry
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = rng.integers(0, 7, n).astype(np.int32)
+    path = str(tmp_path / "clean.csv")
+    _write_csv(path, X, y)
+
+    serial = list(
+        csv_chunks(path, 4, 25, 3, shuffle_seed=9, block_bytes=999, workers=1)
+    )
+    for workers in (2, 4):
+        got = list(
+            csv_chunks(
+                path, 4, 25, 3, shuffle_seed=9, block_bytes=999,
+                workers=workers,
+            )
+        )
+        _chunks_equal(serial, got)
+
+
+def test_parallel_chunks_bit_identical_dirty_quarantine(tmp_path):
+    """Quarantine-dirty stream: chunks AND sidecar contents identical at
+    any worker count (ordered sidecar writes are the sequential stage's
+    contract)."""
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path)
+    outs = {}
+    for workers in (1, 4):
+        qp = str(tmp_path / f"q{workers}.jsonl")
+        outs[workers] = (
+            list(
+                csv_chunks(
+                    path, 4, 25, 2, data_policy="quarantine",
+                    quarantine_path=qp, block_bytes=777, workers=workers,
+                )
+            ),
+            read_quarantine(qp),
+        )
+    _chunks_equal(outs[1][0], outs[4][0])
+    assert outs[1][1] == outs[4][1]
+    assert len(outs[1][1]) > 0  # the stream really was dirty
+
+
+def test_parallel_flags_and_detections_identical(tmp_path):
+    """The acceptance pin: drift flags and detection counts from the
+    chunked engine are bit-identical across worker counts, clean and
+    quarantine-dirty."""
+    from distributed_drift_detection_tpu.engine import ChunkedDetector
+    from distributed_drift_detection_tpu.io.synth import planted_prototypes
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    stream = planted_prototypes(0, concepts=6, rows_per_concept=300, features=6)
+    clean = str(tmp_path / "clean.csv")
+    _write_csv(clean, stream.X, stream.y)
+    dirty = str(tmp_path / "dirty.csv")
+    with open(clean) as fh:
+        header = fh.readline()
+        lines = fh.read().splitlines()
+    for i in range(0, len(lines), 173):
+        lines[i] = "nan," + lines[i].split(",", 1)[1]
+    with open(dirty, "w") as fh:
+        fh.write(header)
+        fh.write("\n".join(lines) + "\n")
+
+    model = build_model("centroid", ModelSpec(6, stream.num_classes))
+
+    def flags_for(path, workers, policy=None, qp=None):
+        det = ChunkedDetector(model, partitions=4, seed=0, window=4)
+        chunks = csv_chunks(
+            path, 4, 30, 3, shuffle_seed=5, block_bytes=2048,
+            workers=workers, data_policy=policy, quarantine_path=qp,
+        )
+        return det.run(chunks)
+
+    ref = flags_for(clean, 1)
+    got = flags_for(clean, 4)
+    for name, a, b in zip(ref._fields, ref, got):
+        np.testing.assert_array_equal(a, b, err_msg=name)
+    assert int((np.asarray(ref.change_global) >= 0).sum()) > 0
+
+    ref_d = flags_for(dirty, 1, "quarantine", str(tmp_path / "qa.jsonl"))
+    got_d = flags_for(dirty, 4, "quarantine", str(tmp_path / "qb.jsonl"))
+    for name, a, b in zip(ref_d._fields, ref_d, got_d):
+        np.testing.assert_array_equal(a, b, err_msg=name)
+    assert read_quarantine(str(tmp_path / "qa.jsonl")) == read_quarantine(
+        str(tmp_path / "qb.jsonl")
+    )
+
+
+def test_property_random_block_sizes_and_workers(tmp_path):
+    """Seeded property sweep: random block sizes × worker counts all
+    reproduce the reference chunks on a dirty stream (block boundaries
+    are implementation detail, never semantics)."""
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path, n=600)
+    qp0 = str(tmp_path / "q_ref.jsonl")
+    ref = list(
+        csv_chunks(
+            path, 4, 20, 2, data_policy="quarantine", quarantine_path=qp0,
+            workers=1,
+        )
+    )
+    sidecar_ref = read_quarantine(qp0)
+    rng = np.random.default_rng(42)
+    for trial in range(6):
+        block_bytes = int(rng.integers(200, 20_000))
+        workers = int(rng.integers(1, 6))
+        qp = str(tmp_path / f"q_{trial}.jsonl")
+        got = list(
+            csv_chunks(
+                path, 4, 20, 2, data_policy="quarantine",
+                quarantine_path=qp, block_bytes=block_bytes, workers=workers,
+            )
+        )
+        _chunks_equal(ref, got)
+        assert read_quarantine(qp) == sidecar_ref, (block_bytes, workers)
+
+
+def test_strict_raises_first_violation_any_worker_count(tmp_path):
+    from distributed_drift_detection_tpu.io.sanitize import (
+        StreamContractError,
+    )
+
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path)
+    msgs = []
+    for workers in (1, 4):
+        with pytest.raises(StreamContractError) as ei:
+            list(
+                csv_chunks(
+                    path, 4, 25, 2, data_policy="strict", block_bytes=777,
+                    workers=workers,
+                )
+            )
+        msgs.append(str(ei.value))
+    assert msgs[0] == msgs[1]
+    assert "data row 3" in msgs[0]  # first violation in ROW order
+
+
+# ---------------------------------------------------------------------------
+# Streaming repair (satellite: csv_chunks data_policy='repair')
+# ---------------------------------------------------------------------------
+
+
+def test_csv_chunks_streaming_repair_matches_running_means(tmp_path):
+    """Block-wise repair imputes each NaN feature cell from the running
+    column means over rows admitted in PRIOR blocks — the serve-admission
+    semantics (RunningColumnStats), deliberately not the one-shot
+    loader's whole-file means."""
+    n, f = 120, 3
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (np.arange(n) % 4).astype(np.int32)
+    path = str(tmp_path / "repair.csv")
+    _write_csv(path, X, y)
+    # poison one known cell deep in the stream (beyond the first blocks)
+    with open(path) as fh:
+        header = fh.readline()
+        lines = fh.read().splitlines()
+    bad_row = 100
+    fields = lines[bad_row].split(",")
+    fields[1] = "nan"
+    lines[bad_row] = ",".join(fields)
+    with open(path, "w") as fh:
+        fh.write(header)
+        fh.write("\n".join(lines) + "\n")
+
+    # small blocks so the bad row is NOT in the first block
+    block_bytes = 1500
+    chunks = list(
+        csv_chunks(
+            path, 2, 10, 2, data_policy="repair", block_bytes=block_bytes,
+            workers=1,
+            quarantine_path=str(tmp_path / "qr.jsonl"),
+        )
+    )
+    # reconstruct the expected imputed value: running mean over all rows
+    # of the blocks BEFORE the bad row's block (exactly what the feeder's
+    # sequential sanitize stage has seen when the block arrives) — same
+    # planner, same whole-file offsets, so boundaries agree exactly
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    data_start = buf.index(b"\n") + 1
+    ranges = line_block_ranges(buf, data_start, block_bytes)
+    rows_before = 0
+    stats = RunningColumnStats(f + 1)
+    for lo, hi in ranges:
+        block_lines = buf[lo:hi].decode().splitlines()
+        rows_here = len(block_lines)
+        if rows_before + rows_here > bad_row:
+            break
+        arr = np.array(
+            [ln.split(",") for ln in block_lines], dtype=np.float64
+        ).astype(np.float32)
+        stats.update(arr)
+        rows_before += rows_here
+    expected = stats.means()[1]
+
+    # find the repaired cell in the striped output: global position ==
+    # bad_row, partition bad_row % 2
+    part = bad_row % 2
+    found = []
+    for chunk in chunks:
+        rows = np.asarray(chunk.rows[part])
+        hit = np.argwhere(rows == bad_row)
+        for b_i, j in hit:
+            if np.asarray(chunk.valid[part])[b_i, j]:
+                found.append(np.asarray(chunk.X[part])[b_i, j, 1])
+    assert len(found) == 1
+    np.testing.assert_allclose(found[0], expected, rtol=1e-6)
+    # the repaired row was NOT quarantined
+    assert not os.path.exists(str(tmp_path / "qr.jsonl"))
+
+
+def test_streaming_repair_parallel_identical_and_quarantines_rest(tmp_path):
+    """repair at any worker count: identical chunks; unrepairable rows
+    (ragged, non-finite label) land in the sidecar like the whole-file
+    repair policy."""
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path)
+    outs = {}
+    for workers in (1, 3):
+        qp = str(tmp_path / f"qr{workers}.jsonl")
+        outs[workers] = (
+            list(
+                csv_chunks(
+                    path, 4, 25, 2, data_policy="repair",
+                    quarantine_path=qp, block_bytes=777, workers=workers,
+                )
+            ),
+            read_quarantine(qp),
+        )
+    _chunks_equal(outs[1][0], outs[3][0])
+    assert outs[1][1] == outs[3][1]
+    reasons = {r["reason"].split(":")[0] for r in outs[1][1]}
+    assert any("ragged" in r for r in reasons)  # unrepairable → sidecar
+    # NaN-cell rows were repaired, not quarantined: fewer sidecar rows
+    # than the quarantine policy drops
+    qq = str(tmp_path / "qq.jsonl")
+    list(
+        csv_chunks(
+            path, 4, 25, 2, data_policy="quarantine", quarantine_path=qq,
+            workers=1,
+        )
+    )
+    assert len(outs[1][1]) < len(read_quarantine(qq))
+
+
+def test_streaming_repair_label_domain_guard(tmp_path):
+    """Repair never fabricates an out-of-domain class index: a
+    non-integral label rounds only when num_classes proves the rounded
+    value stays in 0..C-1 (serve admission's clause); otherwise — out of
+    domain, or domain unknown — the row is quarantined."""
+    rng = np.random.default_rng(8)
+    n, f = 60, 3
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (np.arange(n) % 5).astype(np.int32)
+    path = str(tmp_path / "labels.csv")
+    _write_csv(path, X, y)
+    with open(path) as fh:
+        header = fh.readline()
+        lines = fh.read().splitlines()
+    lines[10] = lines[10].rsplit(",", 1)[0] + ",2.6"  # rounds to 3: in domain
+    lines[20] = lines[20].rsplit(",", 1)[0] + ",4.6"  # rounds to 5: OUT
+    with open(path, "w") as fh:
+        fh.write(header)
+        fh.write("\n".join(lines) + "\n")
+
+    def run(num_classes, tag):
+        qp = str(tmp_path / f"q_{tag}.jsonl")
+        chunks = list(
+            csv_chunks(
+                path, 2, 10, 1, data_policy="repair", quarantine_path=qp,
+                num_classes=num_classes, workers=1,
+            )
+        )
+        quarantined = sorted(
+            r["row"]
+            for r in (read_quarantine(qp) if os.path.exists(qp) else [])
+        )
+        labels = {}
+        for c in chunks:
+            for part in range(2):
+                rows = np.asarray(c.rows[part])
+                valid = np.asarray(c.valid[part])
+                ys = np.asarray(c.y[part])
+                for idx in np.argwhere(valid):
+                    labels[int(rows[tuple(idx)])] = int(ys[tuple(idx)])
+        return quarantined, labels
+
+    # domain known: 2.6 rounds to 3 (admitted), 4.6 would round out → drop
+    quarantined, labels = run(5, "known")
+    assert quarantined == [20]
+    assert labels[10] == 3 and 20 not in labels
+    # domain unknown: both conservatively quarantined, never rounded
+    quarantined, labels = run(None, "unknown")
+    assert quarantined == [10, 20]
+    assert 10 not in labels and 20 not in labels
+
+
+# ---------------------------------------------------------------------------
+# ChunkStriper (pooled striper) == stripe_chunk
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_striper_bit_identical_to_stripe_chunk():
+    from distributed_drift_detection_tpu.io.stream import (
+        ChunkStriper,
+        stripe_chunk,
+    )
+
+    rng = np.random.default_rng(11)
+    p, b, nb = 4, 10, 3
+    span = p * b * nb
+    for seed in (None, 17):
+        striper = ChunkStriper(p, b, nb, shuffle_seed=seed)
+        for k, n in enumerate([span, span, span // 2, 37]):
+            X = rng.normal(size=(n, 5)).astype(np.float32)
+            y = rng.integers(0, 3, n).astype(np.int32)
+            rv = None
+            if k % 2:
+                rv = rng.random(n) > 0.2
+            start = k * span
+            want = stripe_chunk(X, y, start, p, b, nb, seed, row_valid=rv)
+            got = striper.stripe(X, y, start, row_valid=rv)
+            for name, a, c in zip(want._fields, want, got):
+                np.testing.assert_array_equal(a, c, err_msg=f"{seed}/{k}/{name}")
+
+
+def test_chunk_striper_bf16_transport():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    from distributed_drift_detection_tpu.io.stream import (
+        ChunkStriper,
+        stripe_chunk,
+    )
+
+    rng = np.random.default_rng(2)
+    p, b, nb = 2, 8, 2
+    X = rng.normal(size=(25, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 25).astype(np.int32)
+    striper = ChunkStriper(p, b, nb, feature_dtype=ml_dtypes.bfloat16)
+    want = stripe_chunk(
+        X, y, 0, p, b, nb, feature_dtype=ml_dtypes.bfloat16
+    )
+    got = striper.stripe(X, y, 0)
+    assert got.X.dtype == ml_dtypes.bfloat16
+    for name, a, c in zip(want._fields, want, got):
+        np.testing.assert_array_equal(a, c, err_msg=name)
+
+
+def test_striper_output_independent_of_staging_reuse():
+    """Chunks handed downstream must not alias the pooled staging: a
+    later stripe() cannot mutate an earlier chunk."""
+    from distributed_drift_detection_tpu.io.stream import ChunkStriper
+
+    rng = np.random.default_rng(4)
+    striper = ChunkStriper(2, 5, 2)
+    X1 = rng.normal(size=(20, 3)).astype(np.float32)
+    y1 = rng.integers(0, 2, 20).astype(np.int32)
+    first = striper.stripe(X1, y1, 0)
+    snapshot = np.array(first.X, copy=True)
+    striper.stripe(-X1, y1, 20)  # reuses staging with different content
+    np.testing.assert_array_equal(first.X, snapshot)
+
+
+# ---------------------------------------------------------------------------
+# Block planner
+# ---------------------------------------------------------------------------
+
+
+def test_line_block_ranges_invariants():
+    data = b"aa\nbbbb\nc\n" + b"d" * 50 + b"\ne\n"
+    for bb in (1, 3, 7, 100):
+        ranges = line_block_ranges(data, 0, bb)
+        # contiguous, disjoint, covering
+        assert ranges[0][0] == 0 and ranges[-1][1] == len(data)
+        for (alo, ahi), (blo, bhi) in zip(ranges, ranges[1:]):
+            assert ahi == blo
+        # every boundary (except EOF) lands one past a newline
+        for lo, hi in ranges[:-1]:
+            assert data[hi - 1 : hi] == b"\n"
+    # offset start + no trailing newline
+    tail = b"x,1\ny,2"
+    ranges = line_block_ranges(tail, 2, 3)
+    assert ranges[0][0] == 2 and ranges[-1][1] == len(tail)
+    with pytest.raises(ValueError):
+        line_block_ranges(tail, 0, 0)
+
+
+# ---------------------------------------------------------------------------
+# doctor --jobs (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_scan_csv_jobs_identical_ordering(tmp_path):
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path, n=800)
+    serial = scan_csv(path)
+    for jobs in (2, 3, 8):
+        assert scan_csv(path, jobs=jobs) == serial
+    assert len(serial[0]) > 0 and serial[1] == 800
+
+
+def test_doctor_cli_jobs_output_identical(tmp_path, capsys):
+    from distributed_drift_detection_tpu.io.sanitize import main as doctor
+
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path, n=400)
+    outs = []
+    for jobs in ("1", "4"):
+        with pytest.raises(SystemExit) as ei:
+            doctor([path, "--jobs", jobs, "--max-report", "50"])
+        assert ei.value.code == 1
+        outs.append(capsys.readouterr().out)
+    assert outs[0] == outs[1]
+    assert "data row" in outs[0]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline telemetry (tentpole d)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_stage_gauges_recorded(tmp_path):
+    from distributed_drift_detection_tpu.io.feeder import STAGE_BUSY_METRIC
+    from distributed_drift_detection_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    y = rng.integers(0, 4, 500).astype(np.int32)
+    path = str(tmp_path / "s.csv")
+    _write_csv(path, X, y)
+    reg = MetricsRegistry()
+    chunks = list(
+        csv_chunks(path, 2, 10, 2, metrics=reg, workers=2, block_bytes=4096)
+    )
+    assert chunks
+    stages = {
+        dict(k)["stage"]
+        for k in reg.counter(STAGE_BUSY_METRIC).values
+    }
+    assert {"read", "parse", "sanitize", "stripe"} <= stages
+    assert reg.gauge("ingest_workers").values[()] == 2
+    assert ("ingest_parse_queue_depth" in reg.to_json())
+    n_rows = reg.counter("ingest_rows_total").values[()]
+    assert n_rows == 500
+
+
+def test_chunked_run_records_upload_stage(tmp_path):
+    from distributed_drift_detection_tpu.engine import ChunkedDetector
+    from distributed_drift_detection_tpu.io import chunk_stream_arrays
+    from distributed_drift_detection_tpu.io.feeder import STAGE_BUSY_METRIC
+    from distributed_drift_detection_tpu.io.synth import planted_prototypes
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+    from distributed_drift_detection_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    stream = planted_prototypes(0, concepts=4, rows_per_concept=200, features=5)
+    model = build_model("centroid", ModelSpec(5, stream.num_classes))
+    det = ChunkedDetector(model, partitions=2, seed=0)
+    reg = MetricsRegistry()
+    det.run(
+        chunk_stream_arrays(stream.X, stream.y, 2, 20, 2), metrics=reg
+    )
+    key = (("stage", "upload"),)
+    assert reg.counter(STAGE_BUSY_METRIC).values.get(key, 0) > 0
+
+
+def test_chunked_cli_worker_invariance(tmp_path, capsys):
+    """The `chunked` subcommand (the CI smoke's driver): identical
+    detections + quarantine sidecar at 1 vs 3 workers, pipeline gauges in
+    the metric exports."""
+    from distributed_drift_detection_tpu.harness.chunked_cli import main
+
+    path = str(tmp_path / "dirty.csv")
+    _dirty_csv(path, n=500)
+    reports = []
+    for workers in (1, 3):
+        tele = str(tmp_path / f"tele{workers}")
+        qp = str(tmp_path / f"q{workers}.jsonl")
+        main(
+            [
+                path, "--classes", "5", "--partitions", "2",
+                "--per-batch", "20", "--chunk-batches", "2",
+                "--window", "2", "--ingest-workers", str(workers),
+                "--data-policy", "quarantine", "--quarantine-path", qp,
+                "--telemetry-dir", tele, "--block-bytes", "2048",
+            ]
+        )
+        reports.append(json.loads(capsys.readouterr().out.strip()))
+        prom = [
+            p for p in os.listdir(tele) if p.endswith(".prom")
+        ]
+        assert prom, "metric exports missing"
+        text = open(os.path.join(tele, prom[0])).read()
+        assert "ingest_stage_busy_seconds_total" in text
+        assert "ingest_parse_queue_depth" in text
+    a, b = reports
+    assert a["detections"] == b["detections"]
+    assert a["rows"] == b["rows"] and a["quarantined"] == b["quarantined"]
+    assert read_quarantine(str(tmp_path / "q1.jsonl")) == read_quarantine(
+        str(tmp_path / "q3.jsonl")
+    )
