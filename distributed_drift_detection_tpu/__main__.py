@@ -60,6 +60,8 @@ runs experiments):
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
     python -m distributed_drift_detection_tpu top <run.jsonl | DIR>... [--statusz URL]
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
+    python -m distributed_drift_detection_tpu timeline <DIR | logs...> [-o OUT]
+    python -m distributed_drift_detection_tpu explain <DIR | run.jsonl | bundle>
     python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
     python -m distributed_drift_detection_tpu doctor CSV [CSV ...]
 
@@ -78,7 +80,13 @@ registry's completed runs and emits — or ``--execute``s under the
 retry supervisor — the re-run plan for whatever a crash left missing
 (resilience.heal; plan mode is jax-free, exit 0 = sweep whole);
 ``doctor`` validates CSV inputs against the ingest contract jax-free and
-exits nonzero on violations (io.sanitize — the pre-flight for sweeps).
+exits nonzero on violations (io.sanitize — the pre-flight for sweeps);
+``timeline`` merges one or many run logs (daemon + loadgen, or a
+multi-host fleet's per-process logs, clock-skew aligned) into a
+Chrome-trace/Perfetto ``.trace.json`` with the causal serving span
+chains (telemetry.timeline); ``explain`` renders the drift evidence
+bundles a serving daemon extracted under ``<run>.forensics/``
+(telemetry.forensics).
 """
 
 import sys
@@ -96,6 +104,8 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
     "       python -m distributed_drift_detection_tpu top DIR_OR_LOGS [--statusz URL]\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
+    "       python -m distributed_drift_detection_tpu timeline DIR_OR_LOGS [-o OUT]\n"
+    "       python -m distributed_drift_detection_tpu explain DIR_OR_LOG_OR_BUNDLE\n"
     "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
     "       python -m distributed_drift_detection_tpu doctor [--jobs N] CSV [CSV ...]\n"
     "       python -m distributed_drift_detection_tpu chunked CSV --classes C [...]"
@@ -148,6 +158,18 @@ def main(argv: list[str]) -> None:
         from .telemetry.correlate import main as correlate_main
 
         correlate_main(argv[1:])
+        return
+    if argv and argv[0] == "timeline":
+        # jax-free: run logs merge into a Chrome-trace artifact anywhere.
+        from .telemetry.timeline import main as timeline_main
+
+        timeline_main(argv[1:])
+        return
+    if argv and argv[0] == "explain":
+        # jax-free: forensics bundles render wherever the artifacts land.
+        from .telemetry.forensics import main as explain_main
+
+        explain_main(argv[1:])
         return
     if argv and argv[0] == "heal":
         # jax-free in plan mode; --execute pulls in the api lazily.

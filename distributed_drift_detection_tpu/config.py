@@ -315,6 +315,20 @@ class ServeParams(NamedTuple):
     # exception the last N run-log events dump to
     # `<run-log>.flightrec.jsonl`; a clean drain leaves no dump. 0 = off.
     flightrec_events: int = 256
+    # --- trace plane (telemetry.tracing / .forensics) ---
+    # Daemon-side head-sampling rate for rows the client did NOT stamp
+    # with a TRACE wire line: each sampled row gets a fresh root trace
+    # and the full serving span chain in the run log. 0 (default) = off:
+    # zero hot-path tracing work — client-stamped rows are still always
+    # honored (the client already paid the head decision).
+    trace_sample: float = 0.0
+    # Drift forensics: on a drift verdict, extract an evidence bundle
+    # (error-rate trajectory, warn/drift thresholds, window stats,
+    # context rows, sampled trace ids) host-side into
+    # `<run-log>.forensics/` and emit a `drift_forensics` event.
+    # Requires a telemetry dir (bundles anchor to the run log's stem);
+    # False disables capture entirely.
+    forensics: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -503,6 +503,7 @@ class ChunkedDetector:
         telemetry=None,
         metrics=None,
         collect_every: int = 0,
+        tracer=None,
     ) -> FlagRows:
         """Drain an iterator of chunks; concatenates flags on host.
 
@@ -535,6 +536,12 @@ class ChunkedDetector:
         (rows fed + monotonic elapsed — the ``watch`` CLI's liveness
         signal). ``metrics`` records the per-chunk device-memory gauges
         (no sync — usable with or without the event log).
+
+        ``tracer`` (a :class:`..telemetry.tracing.ChunkTracer`, requires
+        ``telemetry``) emits one ``kernel`` span per head-sampled chunk —
+        feed dispatch to the chunk-event sync, the batch pipeline's twin
+        of the serving span chain; the ``timeline`` CLI renders them.
+        Falsy tracers (rate 0 / no log) cost one check per chunk.
         """
         if not collect_every and telemetry is not None:
             collect_every = DEFAULT_TELEMETRY_COLLECT_EVERY
@@ -575,6 +582,7 @@ class ChunkedDetector:
         i = 0
         while placed is not None:
             t_feed = _time.perf_counter()
+            t_feed_mono = _time.monotonic()
             flags = self.feed(placed)
             if c_stage is not None:
                 c_stage.inc(_time.perf_counter() - t_feed, stage="upload")
@@ -585,6 +593,13 @@ class ChunkedDetector:
             if telemetry is not None:
                 flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
                 self.emit_heartbeat(telemetry)
+                if tracer:
+                    # the chunk event's device-side count reduction synced
+                    # on this chunk's compute, so "now" closes the span
+                    tracer.span(
+                        "kernel", i, t_feed_mono, _time.monotonic(),
+                        batches_done=self.batches_done,
+                    )
             elif metrics is not None:
                 self.record_memory_gauges(metrics)
             out.append(flags)  # async; collected at group boundaries/the end

@@ -84,6 +84,12 @@ def main(argv=None) -> None:
         "--compile-cache-dir", default="",
         help="persistent XLA compile cache (warm restarts)",
     )
+    ap.add_argument(
+        "--trace-sample", type=float, default=0.0,
+        help="head-sample chunks at this rate for ingest/kernel trace "
+        "spans in the run log (telemetry.tracing; needs --telemetry-dir; "
+        "0 = off, zero hot-path work)",
+    )
     args = ap.parse_args(argv)
 
     with open(args.csv) as fh:
@@ -145,6 +151,13 @@ def main(argv=None) -> None:
                 if log is not None
                 else args.csv + ".quarantine.jsonl"
             )
+        tracer = None
+        if args.trace_sample > 0 and log is not None:
+            from ..telemetry.tracing import ChunkTracer
+
+            # one tracer for both pipeline halves: the ingest span and
+            # the kernel span of a chunk share one trace
+            tracer = ChunkTracer(log, rate=args.trace_sample, seed=args.seed)
         chunks = prefetch_chunks(
             csv_chunks(
                 args.csv,
@@ -159,12 +172,13 @@ def main(argv=None) -> None:
                 quarantine_path=sidecar,
                 workers=workers,
                 num_classes=args.classes,
+                tracer=tracer,
             ),
             depth=2,
             metrics=reg,
         )
         t0 = time.perf_counter()
-        flags = det.run(chunks, telemetry=log, metrics=reg)
+        flags = det.run(chunks, telemetry=log, metrics=reg, tracer=tracer)
         span = time.perf_counter() - t0
 
         import numpy as np

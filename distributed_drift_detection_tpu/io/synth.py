@@ -91,7 +91,27 @@ def rialto_like_xy(
     return X.astype(np.float32), y
 
 
-_SYNTH_REGISTRY = {"rialto": rialto_like_xy}
+def planted_prototypes_xy(
+    seed: int = 0,
+    concepts: int = 8,
+    rows_per_concept: int = 400,
+    features: int = 7,
+    noise: float = 0.05,
+    label_flip: float = 0.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Raw ``(X, y)`` of :func:`planted_prototypes` — registered as the
+    ``synth:prototypes`` spec so stream replays (``loadgen``, the CI
+    trace-smoke job) can drive a concept-sorted stream with *planted*
+    drift boundaries over the wire: every concept switch is a guaranteed
+    distribution change the detectors fire on."""
+    s = planted_prototypes(
+        seed, concepts=concepts, rows_per_concept=rows_per_concept,
+        features=features, noise=noise, label_flip=label_flip,
+    )
+    return s.X, s.y
+
+
+_SYNTH_REGISTRY = {"rialto": rialto_like_xy, "prototypes": planted_prototypes_xy}
 
 
 def parse_synth(spec: str) -> tuple[np.ndarray, np.ndarray]:

@@ -102,6 +102,25 @@ def _split_buffered(bufs, n_take: int, num_features: int):
     return (take_X, take_y, take_ok, take_ts), rest
 
 
+def _take_marks(
+    marks: "list[dict]", taken_before: int, n_take: int
+) -> "tuple[list[dict], list[dict]]":
+    """One stream's trace marks → the seal's (taken, rest) halves.
+
+    ``marks`` hold absolute admitted positions; the seal covers
+    ``[taken_before, taken_before + n_take)``. The ONE copy of the
+    mark-partition mechanics the solo and tenant seals share (the same
+    rule as :func:`_split_buffered` for the row planes) — taken marks
+    come back position-rebased is the CALLER's job (it owns the seal's
+    index base). Returns ``(taken, rest)``.
+    """
+    end = taken_before + n_take
+    taken = [m for m in marks if m["pos"] < end]
+    if not taken:
+        return [], marks
+    return taken, [m for m in marks if m["pos"] >= end]
+
+
 class SealedChunk(NamedTuple):
     """One flushed microbatch: the striped ``[P, CB, B]`` chunk plus its
     accounting meta (``chunk`` index, ``start_row`` grid position,
@@ -157,6 +176,11 @@ class MicroBatcher:
         self._ts: list[np.ndarray] = []  # per-row monotonic ingest stamps
         self._buffered = 0
         self._first_ts: "float | None" = None  # monotonic, oldest buffered row
+        # Sampled-row trace marks (telemetry.tracing): [{"pos": absolute
+        # admitted position, "trace_id", "parent_id"}], carried into the
+        # covering seal's meta. Empty unless tracing is on — the untraced
+        # path costs one falsy check per push.
+        self._trace_marks: list[dict] = []
         self._queue: list[SealedChunk] = []
         self._error: "BaseException | None" = None
 
@@ -165,6 +189,7 @@ class MicroBatcher:
         X: np.ndarray,
         y: np.ndarray,
         ok: "np.ndarray | None" = None,
+        traces=None,
     ) -> None:
         """Admit a block of rows (arrival order = stream order). Blocks
         while the sealed-chunk queue is full (backpressure to ingress)."""
@@ -186,6 +211,16 @@ class MicroBatcher:
             self._y.append(y)
             self._ok.append(None if ok is None else np.asarray(ok, bool))
             self._ts.append(np.full(len(X), ingest_mono, dtype=np.float64))
+            if traces:
+                base = self.rows_admitted
+                self._trace_marks.extend(
+                    {
+                        "pos": base + int(i),
+                        "trace_id": tid,
+                        "parent_id": pid,
+                    }
+                    for i, tid, pid in traces
+                )
             self._buffered += len(X)
             self.rows_admitted += len(X)
             if self._first_ts is None:
@@ -284,6 +319,19 @@ class MicroBatcher:
             "ingest_mono": take_ts,
             "sealed_mono": time.monotonic(),
         }
+        if self._trace_marks:
+            taken, self._trace_marks = _take_marks(
+                self._trace_marks, taken_before, n_take
+            )
+            if taken:
+                meta["traces"] = [
+                    {
+                        "idx": m["pos"] - taken_before,
+                        "trace_id": m["trace_id"],
+                        "parent_id": m["parent_id"],
+                    }
+                    for m in taken
+                ]
         self._queue.append(SealedChunk(chunk, meta))
         # Grid-slot semantics: the stream position always advances by the
         # full grid span, so the next seal stays aligned to P·B (the
@@ -305,8 +353,8 @@ class _TenantSlot:
         self._batcher = batcher
         self._tenant = tenant
 
-    def push(self, X, y, ok=None) -> None:
-        self._batcher.push(self._tenant, X, y, ok)
+    def push(self, X, y, ok=None, traces=None) -> None:
+        self._batcher.push(self._tenant, X, y, ok, traces)
 
 
 class TenantMicroBatcher:
@@ -412,6 +460,9 @@ class TenantMicroBatcher:
         self._y = [[] for _ in range(tenants)]
         self._ok = [[] for _ in range(tenants)]
         self._ts = [[] for _ in range(tenants)]
+        # per-tenant trace marks (same shape as MicroBatcher's, positions
+        # absolute within that tenant's admitted stream)
+        self._trace_marks: list[list[dict]] = [[] for _ in range(tenants)]
         self._buffered = [0] * tenants
         self._first_ts: "float | None" = None  # oldest buffered row, any tenant
         self._queue: list[SealedChunk] = []
@@ -423,7 +474,7 @@ class TenantMicroBatcher:
     def rows_admitted(self) -> int:
         return sum(self.tenant_rows_admitted)
 
-    def push(self, tenant: int, X, y, ok=None) -> None:
+    def push(self, tenant: int, X, y, ok=None, traces=None) -> None:
         """Admit a block of rows into ``tenant``'s stream (arrival order =
         that tenant's stream order). Blocks while the sealed queue is full
         (backpressure to ingress), like :class:`MicroBatcher`."""
@@ -447,6 +498,16 @@ class TenantMicroBatcher:
             self._ts[tenant].append(
                 np.full(len(X), ingest_mono, dtype=np.float64)
             )
+            if traces:
+                base = self.tenant_rows_admitted[tenant]
+                self._trace_marks[tenant].extend(
+                    {
+                        "pos": base + int(i),
+                        "trace_id": tid,
+                        "parent_id": pid,
+                    }
+                    for i, tid, pid in traces
+                )
             self._buffered[tenant] += len(X)
             self.tenant_rows_admitted[tenant] += len(X)
             if self._first_ts is None:
@@ -533,6 +594,8 @@ class TenantMicroBatcher:
         span = self.rows_per_chunk
         blocks, ts_parts = [], []
         t_rows, t_through, t_start = [], [], []
+        traces: list[dict] = []
+        seal_offset = 0  # index base into the tenant-major ingest array
         any_short = False
         for t in range(self.tenants):
             n_take = span if full else min(self._buffered[t], span)
@@ -556,6 +619,20 @@ class TenantMicroBatcher:
             )
             ts_parts.append(take_ts)
             taken_before = self.tenant_rows_admitted[t] - self._buffered[t]
+            if self._trace_marks[t]:
+                taken, self._trace_marks[t] = _take_marks(
+                    self._trace_marks[t], taken_before, n_take
+                )
+                traces.extend(
+                    {
+                        "idx": seal_offset + m["pos"] - taken_before,
+                        "trace_id": m["trace_id"],
+                        "parent_id": m["parent_id"],
+                        "tenant": t,
+                    }
+                    for m in taken
+                )
+            seal_offset += n_take
             t_rows.append(int(n_take))
             t_through.append(int(taken_before + n_take))
             t_start.append(self.start_rows[t])
@@ -582,6 +659,8 @@ class TenantMicroBatcher:
             "ingest_mono": np.concatenate(ts_parts) if ts_parts else None,
             "sealed_mono": time.monotonic(),
         }
+        if traces:
+            meta["traces"] = traces
         self._queue.append(SealedChunk(chunk, meta))
         self.chunk_index += 1
         self._first_ts = time.monotonic() if any(self._buffered) else None
@@ -685,12 +764,19 @@ class AdmissionController:
         if self._writer is not None:
             self._writer.close()
 
-    def admit_lines(self, lines: list[str]) -> dict:
+    def admit_lines(self, lines: list[str], traces=None) -> dict:
         """Sanitize + admit one block of protocol data lines; returns the
         block's accounting (``error`` is the strict-rejection message for
-        the connection, None otherwise). Thread-safe (serialized)."""
+        the connection, None otherwise). Thread-safe (serialized).
+
+        ``traces`` marks head-sampled rows (telemetry.tracing):
+        ``[(line_index, trace_id, parent_span_id), ...]`` — indices into
+        ``lines``. Marks follow their rows through the policy (a
+        strict-rejected row's mark is dropped with it; quarantined rows
+        keep their positions and their marks) into the batcher, which
+        carries them to the covering seal's meta."""
         with self._lock:
-            return self._admit_lines_locked(lines)
+            return self._admit_lines_locked(lines, traces)
 
     def _parse_block(
         self, lines: list[str]
@@ -720,7 +806,18 @@ class AdmissionController:
             pass
         return sanitize.parse_rows(lines, self.columns)
 
-    def _admit_lines_locked(self, lines: list[str]) -> dict:
+    def _admit_lines_locked(self, lines: list[str], traces=None) -> dict:
+        if traces:
+            # Re-anchor marks across the blank-line filter below so a
+            # mark keeps pointing at ITS row (ingress never sends blanks,
+            # but direct embedders may).
+            kept = [i for i, ln in enumerate(lines) if ln.strip()]
+            remap = {orig: new for new, orig in enumerate(kept)}
+            traces = [
+                (remap[i], tid, pid)
+                for i, tid, pid in traces
+                if i in remap
+            ]
         lines = [
             _json_line_to_csv(ln) if ln.lstrip()[:1] in "{[" else ln
             for ln in lines
@@ -790,6 +887,15 @@ class AdmissionController:
                     self._c_rej.inc(len(bad))
                 keep = np.ones(len(arr), bool)
                 keep[bad] = False
+                if traces:
+                    # rejected rows vanish (no stream position) — their
+                    # marks go with them; survivors shift down
+                    new_idx = np.cumsum(keep) - 1
+                    traces = [
+                        (int(new_idx[i]), tid, pid)
+                        for i, tid, pid in traces
+                        if keep[i]
+                    ]
                 arr = arr[keep]
         else:
             arr, ok = sanitize.apply_block_policy(
@@ -815,5 +921,6 @@ class AdmissionController:
                 arr[:, : self.num_features],
                 arr[:, self.tcol].astype(np.int32),
                 ok,
+                traces or None,
             )
         return {"rows": len(lines), "admitted": admitted, "error": error}

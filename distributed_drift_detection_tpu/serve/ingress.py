@@ -14,6 +14,14 @@ The wire contract (newline-delimited UTF-8, one row per line):
   daemon (and every other tenant's stream) keeps serving. Tenant
   isolation is the multi-tenant plane's point; only genuine
   admission-path failures poison the batcher;
+* ``TRACE <trace_id> <span_id>`` — mark the **next** data row on this
+  connection as head-sampled for end-to-end tracing
+  (``telemetry.tracing``): the row's verdict joins back to the client's
+  trace, and every serving stage attaches a child span to the run log.
+  Ids are lowercase-hex tokens (malformed ones get the same ERR+drop as
+  a bad TENANT id). Independently, a daemon-side sampler
+  (``ServeParams.trace_sample``) can head-sample unstamped rows with
+  fresh root traces; at rate 0 it does nothing;
 * ``FLUSH`` — seal the current partial microbatch now (clients use it to
   close out a replay instead of waiting for the linger deadline);
 * ``STOP`` — request a graceful drain (same path as SIGTERM: in-flight
@@ -56,6 +64,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def setup(self) -> None:
         super().setup()
         self._tenant = 0  # per-connection routing (the TENANT line)
+        self._trace_next = None  # pending TRACE context for the next row
 
     def handle(self) -> None:
         buf = b""
@@ -83,6 +92,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def _process(self, lines: list[str]) -> None:
         server: "IngressServer" = self.server  # type: ignore[assignment]
         block: list[str] = []
+        marks: list[tuple] = []  # (block index, trace_id, span_id)
         for ln in lines:
             s = ln.strip()
             if not s:
@@ -96,8 +106,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 # routed to the PREVIOUS tenant's slot. Admit what
                 # accumulated under the previous tenant first — blocks
                 # are per-tenant by construction.
-                self._admit(block)
-                block = []
+                self._admit(block, marks)
+                block, marks = [], []
                 try:
                     self._tenant = server.check_tenant(int(s[6:].strip()))
                 except (ValueError, IndexError) as e:
@@ -106,24 +116,50 @@ class _Handler(socketserver.BaseRequestHandler):
                     # typo must not take down the other tenants.
                     self._send(f"ERR {type(e).__name__}: {e}")
                     raise _ProtocolReject from e
+            elif s.startswith("TRACE"):
+                # Same no-data-row-starts-with-it argument as TENANT: a
+                # malformed TRACE must reject here, or it would parse as
+                # a dirty data row and silently shift positions.
+                try:
+                    self._trace_next = server.check_trace(s)
+                except (ValueError, IndexError) as e:
+                    self._send(f"ERR {type(e).__name__}: {e}")
+                    raise _ProtocolReject from e
             elif s == "FLUSH":
-                self._admit(block)
-                block = []
+                self._admit(block, marks)
+                block, marks = [], []
                 server.batcher.flush()
             elif s == "STOP":
-                self._admit(block)
-                block = []
+                self._admit(block, marks)
+                block, marks = [], []
                 server.on_stop()
             else:
+                if self._trace_next is not None:
+                    marks.append((len(block), *self._trace_next))
+                    self._trace_next = None
                 block.append(s)
-        self._admit(block)
+        self._admit(block, marks)
 
-    def _admit(self, block: list[str]) -> None:
+    def _admit(self, block: list[str], marks: "list[tuple] | None" = None) -> None:
         if not block:
             return
         server: "IngressServer" = self.server  # type: ignore[assignment]
+        if server.sampler:
+            # Daemon-side head sampling of unstamped rows: fresh root
+            # traces, one decision batch per ingress block. Rate 0 makes
+            # the sampler falsy — this branch costs one bool check.
+            stamped = {i for i, *_ in marks} if marks else set()
+            fresh = [
+                (i, *server.sampler.new_context())
+                for i in server.sampler.sample_block(len(block))
+                if i not in stamped
+            ]
+            if fresh:
+                marks = sorted((marks or []) + fresh)
         try:
-            res = server.admission_for(self._tenant).admit_lines(block)
+            res = server.admission_for(self._tenant).admit_lines(
+                block, traces=marks or None
+            )
         except BaseException as e:
             # The daemon must die loudly on an ingress-path failure (the
             # armed serve.ingress fault is the rehearsal): poison the
@@ -154,13 +190,19 @@ class IngressServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, host: str, port: int, admissions, batcher, on_stop):
+    def __init__(
+        self, host: str, port: int, admissions, batcher, on_stop,
+        sampler=None,
+    ):
         super().__init__((host, port), _Handler)
         # One admission controller per tenant slot (the TENANT protocol
         # line routes); a solo daemon passes a 1-element list.
         self.admissions = list(admissions)
         self.batcher = batcher
         self.on_stop = on_stop
+        # Daemon-side head sampler (telemetry.tracing.HeadSampler) for
+        # rows the client did not TRACE-stamp; None/rate-0 = off.
+        self.sampler = sampler
         self._thread: "threading.Thread | None" = None
 
     def admission_for(self, tenant: int):
@@ -175,6 +217,19 @@ class IngressServer(socketserver.ThreadingTCPServer):
                 f"TENANT {tenant} out of range (daemon serves {n} tenant(s))"
             )
         return tenant
+
+    def check_trace(self, line: str) -> "tuple[str, str]":
+        """Parse + validate a ``TRACE <trace_id> <span_id>`` wire line
+        (untrusted client input; raises ValueError on any malformation)."""
+        from ..telemetry.tracing import check_trace_token
+
+        parts = line.split()
+        if len(parts) != 3:
+            raise ValueError(
+                f"TRACE line needs exactly 'TRACE <trace_id> <span_id>', "
+                f"got {len(parts)} token(s)"
+            )
+        return check_trace_token(parts[1]), check_trace_token(parts[2])
 
     @property
     def port(self) -> int:

@@ -12,6 +12,15 @@ daemon's verdict sidecar and reports **achieved rows/s plus p50/p99
 row→verdict latency** as one JSON line — the SLO evidence ``bench.py
 --serve`` records and the ``perf`` CLI tracks informationally.
 
+Tracing: ``--trace-sample R`` head-samples the replay at rate R — each
+sampled row is preceded by a ``TRACE <trace_id> <span_id>`` wire line
+(telemetry.tracing), so the daemon attaches its serving span chain to
+the client's trace and the verdict record lists the trace ids. With
+``--dir`` the loadgen also writes its own run log into the telemetry
+directory with one root ``ingress`` span per sampled-and-covered row
+(send → verdict observed), so the ``timeline`` CLI merges client and
+daemon into one end-to-end trace.
+
 Latency attribution: every verdict record carries ``rows_through`` — the
 cumulative count of admitted rows up to and including its microbatch —
 and rows are admitted in arrival order, so sent row *i*'s verdict is the
@@ -80,6 +89,68 @@ def apply_dirty(
     rows = int(parts[1]) if len(parts) > 1 else 1
     seed = int(parts[2]) if len(parts) > 2 else 0
     return corrupt_lines(lines, kind, rows=rows, seed=seed, label_col=-1)
+
+
+def sample_traces(
+    n: int, rate: float, seed: "int | None" = 0
+) -> "dict[int, tuple[str, str]]":
+    """Head-sample a replay: row index → fresh ``(trace_id, span_id)``
+    root context for each sampled row. Empty at rate 0 (no work)."""
+    if rate <= 0.0 or n <= 0:
+        return {}
+    from ..telemetry.tracing import HeadSampler
+
+    s = HeadSampler(rate, seed=seed)
+    return {i: s.new_context() for i in s.sample_block(n)}
+
+
+def _stamp_lines(
+    lines: list[str], trace_ctx: "dict[int, tuple[str, str]]"
+) -> list[str]:
+    """Prefix each sampled row's wire payload with its TRACE directive
+    (one list element stays one data row — pacing math is unchanged)."""
+    if not trace_ctx:
+        return lines
+    return [
+        (
+            f"TRACE {trace_ctx[i][0]} {trace_ctx[i][1]}\n{ln}"
+            if i in trace_ctx
+            else ln
+        )
+        for i, ln in enumerate(lines)
+    ]
+
+
+def _emit_client_spans(
+    trace_log,
+    trace_ctx: "dict[int, tuple[str, str]]",
+    send_ts: np.ndarray,
+    verdict_ts: "dict[int, float]",
+) -> int:
+    """Root ``ingress`` spans (send → verdict observed) for every
+    sampled row the verdict stream covered; returns the count."""
+    if trace_log is None or not trace_ctx:
+        return 0
+    from ..telemetry.tracing import emit_span
+
+    n = 0
+    for i in sorted(trace_ctx):
+        end = verdict_ts.get(i)
+        if end is None:
+            continue
+        tid, sid = trace_ctx[i]
+        emit_span(
+            trace_log,
+            name="ingress",
+            trace_id=tid,
+            span_id=sid,
+            parent_id=None,
+            start_ts=float(send_ts[i]),
+            dur_s=end - float(send_ts[i]),
+            row=i,
+        )
+        n += 1
+    return n
 
 
 class _VerdictTail:
@@ -160,6 +231,8 @@ def _run_loadgen_tenants(
     connect_timeout: float = 30.0,
     expect_rows: "int | None" = None,
     interleave: int = 64,
+    trace_ctx: "dict[int, tuple[str, str]] | None" = None,
+    trace_log=None,
 ) -> dict:
     """Multi-tenant replay: the stream is dealt round-robin (blocks of
     ``interleave`` rows) across T tenant slots over ONE connection, with
@@ -188,6 +261,7 @@ def _run_loadgen_tenants(
                     baselines[k] = max(
                         baselines[k], int(ent["rows_through"])
                     )
+    wire = _stamp_lines(lines, trace_ctx or {})
     sock = _connect(host, port, connect_timeout)
     send_ts = np.empty(len(lines), np.float64)
     sent_so_far = 0
@@ -199,7 +273,7 @@ def _run_loadgen_tenants(
                     time.sleep(min(0.002, 1.0 / rate))
             payload = (
                 f"TENANT {t}\n"
-                + "\n".join(lines[i] for i in idx)
+                + "\n".join(wire[i] for i in idx)
                 + "\n"
             )
             sock.sendall(payload.encode())
@@ -250,6 +324,7 @@ def _run_loadgen_tenants(
             time.sleep(0.02)
     lat_ms: list[float] = []
     per_tenant_covered = [0] * tenants
+    verdict_ts: dict[int, float] = {}
     if records:
         for t in range(tenants):
             entries = [
@@ -271,7 +346,14 @@ def _run_loadgen_tenants(
             lat_ms.extend(
                 ((ts[idx[ok]] - send_ts[row_ids]) * 1000.0).tolist()
             )
+            if trace_ctx:
+                for rid, vts in zip(row_ids, ts[idx[ok]]):
+                    if int(rid) in trace_ctx:
+                        verdict_ts[int(rid)] = float(vts)
+    _emit_client_spans(trace_log, trace_ctx or {}, send_ts, verdict_ts)
     return {
+        "rows_traced": len(trace_ctx or {}),
+        "traces_covered": len(verdict_ts),
         "rows_sent": sent,
         "rows_covered": len(lat_ms),
         "tenants": tenants,
@@ -307,19 +389,27 @@ def run_loadgen(
     connect_timeout: float = 30.0,
     expect_rows: "int | None" = None,
     tenants: int = 1,
+    trace_sample: float = 0.0,
+    trace_seed: int = 0,
+    trace_log=None,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
     must cover before the probe stops waiting (default: all sent).
     ``tenants > 1`` deals the stream round-robin across tenant slots of a
     multi-tenant daemon (``TENANT`` protocol lines) with per-tenant
-    latency attribution — see :func:`_run_loadgen_tenants`."""
+    latency attribution — see :func:`_run_loadgen_tenants`.
+    ``trace_sample``/``trace_seed`` head-sample the replay (TRACE wire
+    stamps, telemetry.tracing); ``trace_log`` (an ``EventLog``) receives
+    one root ``ingress`` span per sampled-and-covered row."""
+    trace_ctx = sample_traces(len(lines), trace_sample, trace_seed)
     if tenants > 1:
         return _run_loadgen_tenants(
             host, port, lines, tenants,
             rate=rate, verdicts=verdicts, timeout=timeout, flush=flush,
             stop=stop, connect_timeout=connect_timeout,
-            expect_rows=expect_rows,
+            expect_rows=expect_rows, trace_ctx=trace_ctx,
+            trace_log=trace_log,
         )
     tail = _VerdictTail(verdicts) if verdicts else None
     baseline = 0
@@ -331,7 +421,7 @@ def run_loadgen(
     sock = _connect(host, port, connect_timeout)
     try:
         t0 = time.monotonic()
-        send_ts = _send_rows(sock, lines, rate)
+        send_ts = _send_rows(sock, _stamp_lines(lines, trace_ctx), rate)
         sent_span = time.monotonic() - t0
         if flush:
             sock.sendall(b"FLUSH\n")
@@ -357,6 +447,7 @@ def run_loadgen(
                 break
             time.sleep(0.02)
     lat_ms: list[float] = []
+    verdict_ts: dict[int, float] = {}
     if records:
         recs = sorted(records, key=lambda r: int(r["rows_through"]))
         throughs = np.array([int(r["rows_through"]) for r in recs])
@@ -365,8 +456,19 @@ def run_loadgen(
         idx = np.searchsorted(throughs, pos, side="right")
         ok = idx < len(recs)
         lat_ms = ((ts[idx[ok]] - send_ts[ok]) * 1000.0).tolist()
+        if trace_ctx:
+            covered_rows = np.nonzero(ok)[0]
+            for rid, vts in zip(covered_rows, ts[idx[ok]]):
+                if int(rid) in trace_ctx:
+                    verdict_ts[int(rid)] = float(vts)
+    _emit_client_spans(trace_log, trace_ctx, send_ts, verdict_ts)
     report = {
         "rows_sent": sent,
+        "rows_traced": len(trace_ctx),
+        # traces whose verdict the probe actually observed (== rows_traced
+        # on a fully-covered replay); client root spans are emitted for
+        # exactly these when a trace_log is given
+        "traces_covered": len(verdict_ts),
         "rows_covered": len(lat_ms),
         "verdicts": len(records),
         "detections": sum(int(r["detections"]) for r in records),
@@ -413,6 +515,14 @@ def main(argv=None) -> None:
                     help="max seconds to wait for verdict coverage")
     ap.add_argument("--stop", action="store_true",
                     help="send STOP after the replay (drain the daemon)")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="head-sample the replay at this rate (0..1): "
+                    "sampled rows carry TRACE wire stamps and, with "
+                    "--dir, root ingress spans land in a loadgen run log "
+                    "for the timeline CLI (0 = off)")
+    ap.add_argument("--trace-seed", type=int, default=0,
+                    help="seed for the head-sampling decisions (reproducible "
+                    "trace sets)")
     ap.add_argument("--target-column", default="target")
     args = ap.parse_args(argv)
 
@@ -430,6 +540,18 @@ def main(argv=None) -> None:
         verdicts = find_verdicts(args.telemetry_dir)
         if verdicts is None:
             ap.error(f"no verdict sidecar under {args.telemetry_dir}")
+    trace_log = None
+    if args.trace_sample > 0 and args.telemetry_dir:
+        from ..telemetry.events import EventLog
+
+        trace_log = EventLog.open_run(args.telemetry_dir, name="loadgen")
+        trace_log.emit(
+            "run_started",
+            run_id=trace_log.run_id,
+            config={"kind": "loadgen", "source": args.source,
+                    "trace_sample": args.trace_sample},
+        )
+    t0 = time.monotonic()
     report = run_loadgen(
         args.host,
         args.port,
@@ -439,6 +561,9 @@ def main(argv=None) -> None:
         timeout=args.timeout,
         stop=args.stop,
         tenants=args.tenants,
+        trace_sample=args.trace_sample,
+        trace_seed=args.trace_seed,
+        trace_log=trace_log,
     )
     report.update(
         source=args.source,
@@ -446,6 +571,15 @@ def main(argv=None) -> None:
         classes=num_classes,
         dirty_rows=dirty_rows,
     )
+    if trace_log is not None:
+        trace_log.emit(
+            "run_completed",
+            rows=report["rows_sent"],
+            seconds=time.monotonic() - t0,
+            detections=report["detections"],
+        )
+        trace_log.close()
+        report["trace_log"] = trace_log.path
     print(json.dumps(report))
     raise SystemExit(2 if report["timeout"] else 0)
 

@@ -29,6 +29,19 @@ One :class:`ServeRunner` owns the whole lifecycle:
   ingress, flushes the partial microbatch through the validity plane,
   publishes everything in flight, writes an atomic final checkpoint, and
   flips the registry record to ``completed``.
+* **trace plane** (telemetry.tracing/.forensics) — head-sampled rows
+  (client ``TRACE`` wire lines, or the daemon's own ``--trace-sample``)
+  carry a trace context through admission → microbatcher → kernel →
+  verdict: each stage attaches a child ``span`` event to the run log and
+  the verdict record lists the chunk's trace ids, so a verdict joins
+  back to its originating packet (render with the ``timeline`` CLI). On
+  a drift verdict, ``--forensics`` (default on, needs a telemetry dir)
+  extracts an evidence bundle host-side — error-rate trajectory,
+  warn/drift thresholds, the detector window stats entering the firing
+  chunk, pre/post context rows, sampled trace ids — into
+  ``<run-log>.forensics/`` (render with the ``explain`` CLI; counted in
+  ``/statusz``). Sampling off + forensics off leaves the hot path
+  untouched.
 * **ops plane** (``--ops-port``, telemetry.ops/.slo/.trace) — a threaded
   HTTP server exposes the **live** metrics registry (``/metrics``,
   byte-identical to the ``.prom`` exporter), a drain/poison/stall-aware
@@ -180,6 +193,9 @@ class ServeRunner:
         self._inflight_n = 0
         self._verdict_fh = None
         self.verdicts_path: "str | None" = None
+        self._sampler = None  # daemon-side head sampler (trace plane)
+        self._rows_traced = 0  # rows whose serving span chain was emitted
+        self._forensics = None  # telemetry.forensics.ForensicsExtractor
         self._flag_base = 0  # flag columns published == batches published
         self._published = 0  # chunks published this process
         self._ckpt_at = 0
@@ -284,6 +300,26 @@ class ServeRunner:
         self._verdict_fh = open(
             self.verdicts_path, "a" if resume is not None else "w"
         )
+        if params.trace_sample > 0:
+            from ..telemetry.tracing import HeadSampler
+
+            self._sampler = HeadSampler(params.trace_sample, seed=cfg.seed)
+        if params.forensics and self._log is not None:
+            from ..telemetry.forensics import (
+                FORENSICS_SUFFIX,
+                ForensicsExtractor,
+            )
+
+            self._forensics = ForensicsExtractor(
+                stem + FORENSICS_SUFFIX,
+                run_id=self._log.run_id,
+                detector_params={
+                    "detector": cfg.detector,
+                    **getattr(cfg, cfg.detector)._asdict(),
+                },
+                tenants=self.tenants,
+                metrics=self._metrics,
+            )
         if self.tenants > 1:
             from ..config import tenant_configs
             from .admission import TenantMicroBatcher, _TenantSlot
@@ -408,6 +444,7 @@ class ServeRunner:
                 self.admissions,
                 self.batcher,
                 self.request_stop,
+                sampler=self._sampler,
             )
             self._ingress.start()
         # SLO engine + evaluator thread: the judge must not live on the
@@ -574,6 +611,18 @@ class ServeRunner:
             "checkpoint": self.params.checkpoint or None,
             "resumed": self.resumed_meta is not None,
             "alerts": self._slo.active() if self._slo is not None else [],
+            "tracing": {
+                "sample_rate": self.params.trace_sample,
+                "rows_traced": self._rows_traced,
+            },
+            "forensics": {
+                "enabled": self._forensics is not None,
+                "bundles": (
+                    self._forensics.bundles_written
+                    if self._forensics is not None
+                    else 0
+                ),
+            },
         }
 
     # -- the loop ------------------------------------------------------------
@@ -598,11 +647,26 @@ class ServeRunner:
                     self.batcher.flush()
                 item = self.batcher.get(0.0 if inflight else params.poll_s)
                 if item is not None:
+                    # Forensics: copy the detector state ENTERING this
+                    # chunk before the feed donates the carry (an async
+                    # device-side copy of a few [P] scalars; materialized
+                    # host-side at publish, when the chunk's compute is
+                    # done anyway). None when forensics is off.
+                    entry = self._capture_entry()
                     flags = self.det.feed(self.det.place(item.chunk))
                     # Row-tracing stamp: the chunk entered the device
                     # pipeline (queue stage ends, device stage begins).
                     item.meta["fed_mono"] = time.monotonic()
-                    inflight.append((flags, item.meta))
+                    inflight.append(
+                        (
+                            flags,
+                            item.meta,
+                            entry,
+                            # the chunk's numpy-backed host copy, kept only
+                            # while forensics needs its context rows
+                            item.chunk if self._forensics is not None else None,
+                        )
+                    )
                 self._inflight_n = len(inflight)
                 if inflight and (item is None or len(inflight) >= self._depth):
                     self._publish(*inflight.pop(0))
@@ -641,7 +705,21 @@ class ServeRunner:
             self._fail()
             raise
 
-    def _publish(self, flags, meta: dict) -> None:
+    def _capture_entry(self):
+        """Device-side copy of the detector state entering the next chunk
+        (forensics evidence; the copy is dispatched BEFORE the next feed
+        donates the carry, so the buffers are still live). ``None`` when
+        forensics is off or no carry exists yet (a fresh plane's first
+        chunk enters with init state — the bundle's window stats are
+        simply absent there)."""
+        if self._forensics is None or self.det.carry is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(jnp.copy, self.det.carry.ddm)
+
+    def _publish(self, flags, meta: dict, entry=None, chunk=None) -> None:
         """Collect one chunk's flags host-side and publish its verdict
         (the row→verdict latency endpoint)."""
         import jax
@@ -693,6 +771,10 @@ class ServeRunner:
                 }
                 for t in range(self.tenants)
             ]
+        trace_marks = meta.get("traces") or ()
+        if trace_marks:
+            # the sidecar verdict joins back to its originating packets
+            record["traces"] = [m["trace_id"] for m in trace_marks]
         line = json.dumps(record)
         # Fault-injection site (resilience.faults; no-op unless armed):
         # raise = die after the chunk's state advanced but before its
@@ -724,6 +806,29 @@ class ServeRunner:
         self._last_meta = meta
         if self._keep is not None:
             self._keep.append(host)
+        trace_ids: list = []
+        if trace_marks and self._log is not None:
+            from ..telemetry.tracing import emit_row_spans
+
+            trace_ids = emit_row_spans(
+                self._log,
+                meta,
+                collected_mono=collected_mono,
+                published_mono=published_mono,
+            )
+            self._rows_traced += len(trace_ids)
+        if self._forensics is not None and chunk is not None:
+            entry_host = (
+                jax.tree.map(np.asarray, entry) if entry is not None else None
+            )
+            self._forensics.on_publish(
+                meta,
+                host,
+                chunk,
+                entry_host,
+                log=self._log,
+                trace_ids=trace_ids,
+            )
         if self._log is not None:
             from ..telemetry.events import emit_flag_events
 
@@ -934,6 +1039,15 @@ def main(argv=None) -> None:
                     help="SLO evaluator cadence (its own thread)")
     ap.add_argument("--flightrec-events", type=int, default=256,
                     help="crash flight-recorder ring capacity (0 = off)")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="daemon-side head-sampling rate (0..1) for rows "
+                    "the client did not TRACE-stamp: sampled rows get the "
+                    "full serving span chain in the run log (0 = off, "
+                    "zero hot-path work; client TRACE lines always honored)")
+    ap.add_argument("--no-forensics", action="store_true",
+                    help="disable drift evidence bundles "
+                    "(<run-log>.forensics/; on by default with a "
+                    "telemetry dir)")
     args = ap.parse_args(argv)
 
     # CLI-driven fault arming (DDD_FAULTS, the grid harness's pattern):
@@ -974,6 +1088,8 @@ def main(argv=None) -> None:
         slo=tuple(args.slo) if args.slo else ServeParams._field_defaults["slo"],
         slo_interval_s=args.slo_interval_s,
         flightrec_events=args.flightrec_events,
+        trace_sample=args.trace_sample,
+        forensics=not args.no_forensics,
     )
     runner = ServeRunner(cfg, params, max_chunks=args.max_chunks)
     banner = runner.start()

@@ -44,6 +44,21 @@ subsystem persists that answer as artifacts instead:
   ``serve_row_latency_seconds{stage=…}`` live histograms (vectorized
   per-row observe) and histogram-quantile helpers for both the live
   registry and parsed scrapes.
+* :mod:`.tracing` — the causal trace plane: trace-context propagation
+  (``TRACE`` wire lines, head-sampled — zero hot-path work at rate 0)
+  and schema-v1 ``span`` events for the serving chain
+  (ingress→admission→batch→kernel→verdict) and the batch pipeline
+  (``ChunkTracer``: ingest/kernel per chunk).
+* :mod:`.timeline` — ``python -m distributed_drift_detection_tpu
+  timeline <dir|logs>``: merge any set of run logs (daemon + loadgen,
+  multi-host fleets — clock-skew aligned per correlate's rule) into one
+  Chrome-trace/Perfetto ``.trace.json``.
+* :mod:`.forensics` — drift evidence bundles: on a drift verdict the
+  serving daemon extracts error-rate trajectory, warn/drift thresholds,
+  detector window stats, context rows and sampled trace ids into
+  ``<run>.forensics/`` (announced by ``drift_forensics`` events,
+  counted in ``/statusz``); ``python -m distributed_drift_detection_tpu
+  explain`` renders bundles.
 * :mod:`.slo` — declarative SLO rules (p99 latency, verdict staleness,
   quarantine rate, event stall) evaluated on a cadence; threshold
   crossings emit schema-v1 ``alert`` events and drive ``/healthz``.

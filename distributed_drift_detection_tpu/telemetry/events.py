@@ -95,6 +95,23 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # thread — the serve path only, strictly outside any api.run Final
     # Time span (purity holds by construction).
     "alert": ("rule", "state", "value", "threshold"),
+    # One causal trace span (telemetry.tracing): ``trace_id`` groups every
+    # span of one traced unit of work (a sampled ingress row, a batch
+    # chunk), ``span_id`` names this span, ``parent_id`` its parent (None
+    # for a root span). ``start_ts`` is the span's wall-clock start in
+    # unix seconds (monotonic stamps are rebased host-side before emit,
+    # telemetry.tracing.wall_of), ``dur_s`` its duration. Head-sampled:
+    # at sample rate 0 nothing on the hot path even looks at a clock.
+    # The ``timeline`` CLI merges spans (with correlate's clock
+    # alignment) into a Chrome-trace/Perfetto artifact.
+    "span": ("name", "trace_id", "span_id", "parent_id", "start_ts", "dur_s"),
+    # A drift evidence bundle landed (telemetry.forensics, serving
+    # daemon): partition/global_pos locate the firing flag exactly like
+    # ``drift_detected``; ``bundle`` is the bundle file's path relative
+    # to the run log's directory (under ``<run>.forensics/``). Extracted
+    # host-side from the already-collected flag tables + the chunk's
+    # host copy — never from jitted code.
+    "drift_forensics": ("chunk", "partition", "global_pos", "bundle"),
     # one per run log, last event: totals over the reference's Final Time
     "run_completed": ("rows", "seconds", "detections"),
 }
@@ -111,6 +128,7 @@ _NULLABLE = frozenset(
         ("drift_detected", "delay_rows"),
         ("cost_analysis", "flops"),
         ("cost_analysis", "bytes_accessed"),
+        ("span", "parent_id"),  # root spans have no parent
     }
 )
 

@@ -194,8 +194,10 @@ class MetricsRegistry:
         lines = []
         for name in sorted(self._metrics.copy()):
             m = self._metrics[name]
-            if m.help:
-                lines.append(f"# HELP {name} {_escape(m.help)}")
+            # HELP and TYPE for EVERY series (exposition-format
+            # conformance: scrapers key docs off HELP presence); an empty
+            # help renders as a bare `# HELP name` line, never skipped.
+            lines.append(f"# HELP {name} {_escape(m.help)}".rstrip())
             lines.append(f"# TYPE {name} {m.kind}")
             for key in sorted(m.values.copy()):
                 if m.kind == "histogram":

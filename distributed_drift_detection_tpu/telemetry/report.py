@@ -45,6 +45,9 @@ def summarize(events: list[dict]) -> dict:
         "legs": [],
         "retried": [],
         "alerts": [],
+        "spans": 0,
+        "trace_ids": set(),
+        "forensics": [],
         "quarantine": None,
         "heartbeat": None,
         "completed": None,
@@ -76,6 +79,11 @@ def summarize(events: list[dict]) -> dict:
             s["retried"].append(e)
         elif t == "alert":
             s["alerts"].append(e)
+        elif t == "span":
+            s["spans"] += 1
+            s["trace_ids"].add(e["trace_id"])
+        elif t == "drift_forensics":
+            s["forensics"].append(e)
         elif t == "rows_quarantined":
             s["quarantine"] = e
         elif t == "heartbeat":
@@ -327,6 +335,18 @@ def render_report(events: list[dict]) -> str:
         out.append(
             f"legs       {len(s['legs'])} completed, {leg_rows:,} rows, "
             f"{det} detections"
+        )
+    if s["spans"]:
+        out.append(
+            f"tracing    {s['spans']} span(s) over "
+            f"{len(s['trace_ids'])} trace(s)  "
+            "(render: the `timeline` CLI)"
+        )
+    if s["forensics"]:
+        newest = s["forensics"][-1]
+        out.append(
+            f"forensics  {len(s['forensics'])} drift evidence bundle(s)  "
+            f"(newest: {newest['bundle']}; render: the `explain` CLI)"
         )
     return "\n".join(out)
 

@@ -32,6 +32,27 @@ except AttributeError:
 import pytest  # noqa: E402
 
 # ---------------------------------------------------------------------------
+# Reference-dataset guard (skip-if-missing). The seed repo's end-to-end
+# tests read the reference's outdoorStream.csv from /root/reference, which
+# is only mirrored on the original machine. Tests whose ONLY dependency on
+# that mirror is the data itself carry this mark: where the file is absent
+# they skip with a clear reason instead of failing, so a red tier-1 run
+# means a real regression, never absent data. (The oracle/spec tests that
+# re-derive the semantics from SURVEY.md run everywhere and are the
+# behavioural safety net on data-less machines.)
+# ---------------------------------------------------------------------------
+
+REFERENCE_DATASET = "/root/reference/outdoorStream.csv"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(REFERENCE_DATASET),
+    reason=(
+        "skip-if-missing: reference dataset "
+        f"{REFERENCE_DATASET} is not mirrored on this machine"
+    ),
+)
+
+# ---------------------------------------------------------------------------
 # Fast/slow tiers. The suite outgrew a single serial run (~14.5 min in round
 # 2); the heavy tail — multi-process launches, chained-soak contracts,
 # property fuzzing, chunked-engine end-to-end — is marked @pytest.mark.slow

@@ -7,6 +7,7 @@ import pytest
 
 from distributed_drift_detection_tpu import RunConfig, replace, run
 from distributed_drift_detection_tpu.results import read_results
+from conftest import needs_reference
 
 OUTDOOR = "/root/reference/outdoorStream.csv"
 
@@ -31,6 +32,7 @@ def base_cfg(tmp_path, **kw):
     )
 
 
+@needs_reference
 def test_single_partition_outdoor(tmp_path):
     """The minimum end-to-end slice: 1 chip, 1 partition, outdoorStream —
     detections at concept boundaries with sub-batch delay."""
@@ -45,6 +47,7 @@ def test_single_partition_outdoor(tmp_path):
     assert len(hit_concepts) >= 30
 
 
+@needs_reference
 def test_multi_partition_consistency(tmp_path):
     """8 partitions on the same stream: every partition sees the same
     boundaries (1/8-thinned), so detection count scales ~×8 and the mean
@@ -55,6 +58,7 @@ def test_multi_partition_consistency(tmp_path):
     assert res.metrics.mean_delay_rows < 8 * 100
 
 
+@needs_reference
 def test_results_csv_roundtrip(tmp_path):
     cfg = base_cfg(tmp_path, time_string="t0")
     run(cfg)
@@ -67,6 +71,7 @@ def test_results_csv_roundtrip(tmp_path):
     assert float(rows[0]["Rows Per Sec"]) > 0
 
 
+@needs_reference
 def test_timings_present(tmp_path):
     res = run(base_cfg(tmp_path))
     for phase in ("prepare", "upload", "detect", "collect"):
@@ -85,6 +90,7 @@ def test_unknown_backend_rejected(tmp_path):
         run(base_cfg(tmp_path, backend="dask"))
 
 
+@needs_reference
 def test_linear_model_end_to_end(tmp_path):
     res = run(base_cfg(tmp_path, model="linear", shuffle_batches=True))
     assert res.metrics.num_detections >= 25
@@ -107,6 +113,7 @@ def test_trace_dir_writes_profile(tmp_path):
     assert found, "profiler trace directory is empty"
 
 
+@needs_reference
 def test_auto_window_resolves_from_stream_geometry(tmp_path):
     """window=0 (the default) co-resolves the W×R policy from the planted
     drift spacing and records the resolved values in the result config."""

@@ -60,6 +60,8 @@ from distributed_drift_detection_tpu.ops.detectors import (
     stepd_window,
 )
 
+from conftest import needs_reference
+
 PH = PHParams(min_num_instances=5, delta=0.005, threshold=3.0)
 ED = EDDMParams(min_num_errors=5)
 
@@ -795,6 +797,7 @@ def test_hddm_w_rejects_bad_params():
         hddm_w_step(hddm_w_init(), jnp.float32(1.0), HDDMWParams(lam=2.0))
 
 
+@needs_reference
 def test_ph_threshold_zero_means_auto():
     """PHParams.threshold = 0 (the default) is 'auto': kernels refuse it
     unresolved, config.auto_ph_threshold resolves it from stream geometry,
@@ -933,6 +936,7 @@ def _api_run(detector, **cfg_kw):
     return run(cfg)
 
 
+@needs_reference
 @pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"])
 @pytest.mark.parametrize("window", [1, 8])
 def test_api_detects_planted_drifts(detector, window):
@@ -954,6 +958,7 @@ def _sequential_flags(detector):
     return _api_run(detector, window=1).flags
 
 
+@needs_reference
 @pytest.mark.parametrize("rotations", [1, 3])
 @pytest.mark.parametrize("detector", ["ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"])
 def test_window_engine_matches_sequential(detector, rotations):

@@ -1,0 +1,368 @@
+"""Drift forensics: evidence bundles vs the sequential oracle's internals.
+
+The headline acceptance (ISSUE 11): every planted drift served through
+the daemon gets an evidence bundle under ``<run-log>.forensics/`` whose
+firing-point stats — the detector state entering the firing chunk, the
+effective warn/drift thresholds, the error-rate trajectory — match the
+pure-Python :class:`oracle.OracleDDM` run over the same stream exactly
+(f32 for f32), and the ``explain`` CLI renders it.
+"""
+
+import glob
+import json
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from oracle import OracleDDM
+
+from distributed_drift_detection_tpu import RunConfig
+from distributed_drift_detection_tpu.config import DDMParams, ServeParams
+from distributed_drift_detection_tpu.io import planted_prototypes
+from distributed_drift_detection_tpu.serve import ServeRunner
+from distributed_drift_detection_tpu.serve.loadgen import format_lines
+from distributed_drift_detection_tpu.telemetry import forensics
+from distributed_drift_detection_tpu.telemetry.events import read_events
+
+REF = DDMParams()
+
+
+def _drive(runner, lines, block=150):
+    for i in range(0, len(lines), block):
+        runner.admission.admit_lines(lines[i : i + block])
+    runner.batcher.flush()
+    runner.request_stop()
+    assert runner.serve_forever() == 0
+    return runner
+
+
+def _planted_stream(seed, concepts=5, rows_per_concept=300, flip=0.06):
+    """Concept c = constant label c with a few flipped labels: the
+    majority model is perfect inside a concept (minus flips) and 100%
+    wrong right after a boundary — planted, detectable drift whose error
+    sequence is trivially known."""
+    rng = np.random.default_rng(seed)
+    n = concepts * rows_per_concept
+    y = np.repeat(np.arange(concepts, dtype=np.int32), rows_per_concept)
+    flips = rng.random(n) < flip
+    y[flips] = rng.integers(0, concepts, int(flips.sum())).astype(np.int32)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    return X, y, concepts
+
+
+class _OracleReplay:
+    """Sequential replay of the serve pipeline's per-partition loop
+    (majority model, no shuffle, P=1) capturing the DDM state at every
+    chunk boundary — the oracle side of the bundle comparison."""
+
+    def __init__(self, y, per_batch, chunk_batches):
+        self.per_batch = per_batch
+        self.cb = chunk_batches
+        self.batches = [
+            y[s : s + per_batch] for s in range(0, len(y), per_batch)
+        ]
+        self.entry_states = {}  # chunk index -> state dict or None (fresh)
+        self.changes = []  # (chunk, batch_col_in_chunk, global_pos)
+        self._run()
+
+    @staticmethod
+    def _state(ddm):
+        if ddm is None:  # freshly reset: the kernel's init state
+            return {
+                "count": 0, "err_sum": 0.0,
+                "ps_min": None, "p_min": None, "s_min": None,
+            }
+        return {
+            "count": ddm.count,
+            "err_sum": ddm.err_sum,
+            "ps_min": None if math.isinf(ddm.ps_min) else ddm.ps_min,
+            "p_min": None if math.isinf(ddm.p_min) else ddm.p_min,
+            "s_min": None if math.isinf(ddm.s_min) else ddm.s_min,
+        }
+
+    def _run(self):
+        ddm = None
+        majority = None
+        retrain = True
+        batch_a = self.batches[0]
+        for m in range(1, len(self.batches)):
+            if m % self.cb == 0:
+                # state ENTERING chunk m // cb — what the daemon snapshots
+                self.entry_states[m // self.cb] = self._state(ddm)
+            if retrain:
+                vals, counts = np.unique(batch_a, return_counts=True)
+                majority = int(vals[np.argmax(counts)])
+                retrain = False
+            b = self.batches[m]
+            errs = (b != majority).astype(np.float32)
+            if ddm is None:
+                ddm = OracleDDM(
+                    min_num_instances=REF.min_num_instances,
+                    warning_level=REF.warning_level,
+                    out_control_level=REF.out_control_level,
+                )
+            fired = False
+            for i, err in enumerate(errs):
+                ddm.add_element(float(err))
+                if ddm.in_change:
+                    pos = m * self.per_batch + i
+                    chunk = m // self.cb
+                    col = m % self.cb if chunk > 0 else m - 1
+                    self.changes.append((chunk, int(pos)))
+                    fired = True
+                    break
+            if fired:
+                batch_a = b
+                ddm = None
+                retrain = True
+
+
+def test_bundles_match_oracle_internals(tmp_path, monkeypatch):
+    """The tier-1 forensics acceptance (P=1, majority model, no shuffle:
+    the serve pipeline IS the sequential reference loop, so the bundle's
+    firing-point stats must equal the oracle's internals bit-for-bit)."""
+    monkeypatch.chdir(tmp_path)
+    X, y, classes = _planted_stream(2)
+    per_batch, cb = 50, 2
+    cfg = RunConfig(
+        partitions=1, per_batch=per_batch, model="majority",
+        shuffle_batches=False, results_csv="", seed=0, window=1,
+        data_policy="quarantine", telemetry_dir=str(tmp_path / "tele"),
+    )
+    params = ServeParams(
+        num_features=X.shape[1], num_classes=classes, port=None,
+        chunk_batches=cb, linger_s=0.05,
+    )
+    runner = ServeRunner(cfg, params, keep_flags=True)
+    banner = runner.start()
+    _drive(runner, format_lines(X, y))
+
+    flags = runner.flags()
+    cg = np.asarray(flags.change_global)[0]
+    fired = [int(p) for p in cg[cg >= 0]]
+    assert len(fired) >= 3, "planted stream must actually fire"
+
+    oracle = _OracleReplay(y, per_batch, cb)
+    assert [pos for _, pos in oracle.changes] == fired
+
+    bundles = sorted(
+        glob.glob(
+            os.path.splitext(banner["run_log"])[0] + ".forensics/drift-*.json"
+        )
+    )
+    by_pos = {}
+    for p in bundles:
+        b = forensics.read_bundle(p)
+        by_pos[b["global_pos"]] = b
+    # one bundle per fired flag
+    assert sorted(by_pos) == sorted(fired)
+
+    for chunk, pos in oracle.changes:
+        b = by_pos[pos]
+        assert b["chunk"] == chunk and b["partition"] == 0
+        want = oracle.entry_states.get(chunk)
+        if want is None:
+            continue  # chunk 0 has no entry snapshot by contract
+        got = b["window"]
+        assert int(got["count"]) == want["count"]
+        for k in ("err_sum", "ps_min", "p_min", "s_min"):
+            if want[k] is None:
+                assert got[k] is None, (k, got)
+            else:
+                assert got[k] == pytest.approx(
+                    np.float32(want[k]), rel=0, abs=0
+                ), (pos, k)
+        # the derived running error rate (f32 division, kernel semantics)
+        if want["count"] > 0:
+            assert got["error_rate"] == pytest.approx(
+                float(np.float32(want["err_sum"]) / np.float32(want["count"]))
+            )
+        # effective thresholds recompute from the same minima
+        if want["p_min"] is not None:
+            s_band = np.float32(want["s_min"])
+            assert b["thresholds"]["drift"] == pytest.approx(
+                float(
+                    np.float32(want["p_min"])
+                    + np.float32(REF.out_control_level) * s_band
+                )
+            )
+        # trajectory's newest entry is the firing chunk's entry state
+        if b["trajectory"]:
+            last = b["trajectory"][-1]
+            assert last["chunk"] == chunk
+            assert last["count"] == want["count"]
+        # context rows quote the real stream around the firing point
+        ctx = b["context"]
+        for row in ctx["pre"]:
+            assert row["pos"] < pos and row["y"] == int(y[row["pos"]])
+        assert ctx["post"][0]["pos"] == pos
+        for row in ctx["post"]:
+            assert row["y"] == int(y[row["pos"]])
+
+    # announced in the run log + counted in the live surfaces
+    events = read_events(banner["run_log"])
+    announced = [e for e in events if e["type"] == "drift_forensics"]
+    assert {e["global_pos"] for e in announced} == set(fired)
+    for e in announced:
+        assert os.path.exists(
+            os.path.join(str(tmp_path / "tele"), e["bundle"])
+        )
+    assert runner._statusz()["forensics"] == {
+        "enabled": True,
+        "bundles": len(fired),
+    }
+    c = runner.metrics.counter(forensics.FORENSICS_METRIC)
+    assert c.values[()] == len(fired)
+
+
+def test_forensics_off_or_untelemetered_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(3, concepts=3, rows_per_concept=480,
+                                features=7)
+    # forensics=False with telemetry on
+    cfg = RunConfig(
+        partitions=4, per_batch=50, model="centroid", shuffle_batches=True,
+        results_csv="", seed=3, window=1, data_policy="quarantine",
+        telemetry_dir=str(tmp_path / "tele"),
+    )
+    params = ServeParams(
+        num_features=7, num_classes=3, port=None, chunk_batches=2,
+        linger_s=0.05, forensics=False,
+    )
+    runner = ServeRunner(cfg, params, keep_flags=True)
+    runner.start()
+    _drive(runner, format_lines(stream.X, stream.y))
+    assert runner._detections > 0
+    assert not glob.glob(str(tmp_path / "tele" / "*.forensics"))
+    assert runner._statusz()["forensics"] == {"enabled": False, "bundles": 0}
+
+    # telemetry off: nothing to anchor bundles to → no extractor
+    cfg2 = RunConfig(
+        partitions=4, per_batch=50, model="centroid", shuffle_batches=True,
+        results_csv="", seed=3, window=1, data_policy="quarantine",
+    )
+    r2 = ServeRunner(cfg2, params._replace(forensics=True), keep_flags=True)
+    r2.start()
+    _drive(r2, format_lines(stream.X, stream.y))
+    assert r2._statusz()["forensics"]["enabled"] is False
+
+
+def test_multi_tenant_bundles_attribute_tenant(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(4, concepts=3, rows_per_concept=480,
+                                features=6)
+    cfg = RunConfig(
+        partitions=2, per_batch=50, tenants=2, model="centroid",
+        shuffle_batches=True, results_csv="", seed=4, window=1,
+        data_policy="quarantine", telemetry_dir=str(tmp_path / "tele"),
+    )
+    params = ServeParams(
+        num_features=6, num_classes=3, port=None, chunk_batches=2,
+        linger_s=0.05,
+    )
+    runner = ServeRunner(cfg, params, keep_flags=True)
+    banner = runner.start()
+    lines = format_lines(stream.X, stream.y)
+    # both tenants get the same stream
+    for t in range(2):
+        for i in range(0, len(lines), 200):
+            runner.admissions[t].admit_lines(lines[i : i + 200])
+    runner.batcher.flush()
+    runner.request_stop()
+    assert runner.serve_forever() == 0
+    bundles = [
+        forensics.read_bundle(p)
+        for p in glob.glob(
+            os.path.splitext(banner["run_log"])[0] + ".forensics/drift-*.json"
+        )
+    ]
+    assert bundles
+    for b in bundles:
+        assert b["tenant"] in (0, 1)
+        assert 0 <= b["tenant_partition"] < 2
+        assert b["partition"] == b["tenant"] * 2 + b["tenant_partition"]
+    # identical streams → symmetric evidence across the tenant plane
+    assert {b["tenant"] for b in bundles} == {0, 1}
+
+
+# --- unit surfaces ---------------------------------------------------------
+
+
+def test_state_fields_generic_and_derived():
+    from collections import namedtuple
+
+    S = namedtuple("S", "count err_sum ps_min p_min s_min")
+    s = S(
+        count=np.array([10, 20]),
+        err_sum=np.array([2.0, 5.0], np.float32),
+        ps_min=np.array([0.3, np.inf], np.float32),
+        p_min=np.array([0.2, np.inf], np.float32),
+        s_min=np.array([0.1, np.inf], np.float32),
+    )
+    f0 = forensics.state_fields(s, 0)
+    assert f0["count"] == 10 and f0["error_rate"] == pytest.approx(0.2)
+    f1 = forensics.state_fields(s, 1)
+    assert f1["ps_min"] is None  # inf → JSON-safe null, never Infinity
+    assert forensics.state_fields(None, 0) == {}
+    # non-namedtuple states fall back to positional names
+    g = forensics.state_fields((np.array([1.0, 2.0]),), 1)
+    assert g == {"leaf0": 2.0}
+
+
+def test_effective_thresholds_noise_floor():
+    window = {"p_min": 0.2, "s_min": 0.0}
+    base = {"warning_level": 0.5, "out_control_level": 1.5}
+    th = forensics.effective_thresholds(window, base)
+    assert th["warn"] == pytest.approx(0.2) and th["drift"] == pytest.approx(0.2)
+    th = forensics.effective_thresholds(
+        window, {**base, "noise_floor": 0.15}
+    )
+    band = np.float32(0.15) / np.float32(1.5)
+    assert th["drift"] == pytest.approx(float(np.float32(0.2) + 1.5 * band))
+    assert forensics.effective_thresholds({}, base) == {}
+
+
+def test_explain_cli_renders_and_fails_on_empty(tmp_path, capsys):
+    bundle = {
+        "v": 1, "kind": "drift_forensics", "run_id": "r", "ts": 0.0,
+        "chunk": 2, "batch": 1, "partition": 0, "tenant": None,
+        "tenant_partition": None, "global_pos": 123,
+        "warning": {"local": 3, "global_pos": 120},
+        "detector": {"detector": "ddm", "out_control_level": 1.5},
+        "window": {"count": 50, "error_rate": 0.1},
+        "thresholds": {"warn": 0.2, "drift": 0.3},
+        "trajectory": [{"chunk": 1, "rows_through": 100, "count": 50,
+                        "error_rate": 0.1}],
+        "context": {"pre": [{"pos": 122, "x": [1.0], "y": 0, "valid": True}],
+                    "post": [{"pos": 123, "x": [2.0], "y": 1,
+                              "valid": False}]},
+        "trace_ids": ["a" * 32],
+        "rows_through": 200,
+    }
+    d = tmp_path / "run.forensics"
+    d.mkdir()
+    (d / "drift-c2-p0-r123.json").write_text(json.dumps(bundle))
+    forensics.main([str(d)])
+    out = capsys.readouterr().out
+    assert "drift @ row 123" in out
+    assert "first warning" in out and "[masked]" in out
+    assert "1 bundle(s)" in out
+    with pytest.raises(SystemExit):
+        forensics.main([str(tmp_path / "nowhere")])
+
+
+def test_find_bundles_resolution_forms(tmp_path):
+    tele = tmp_path / "tele"
+    d = tele / "run-1.forensics"
+    d.mkdir(parents=True)
+    b = d / "drift-c0-p0-r1.json"
+    b.write_text("{}")
+    log = tele / "run-1.jsonl"
+    log.write_text("")
+    assert forensics.find_bundles(str(b)) == [str(b)]
+    assert forensics.find_bundles(str(d)) == [str(b)]
+    assert forensics.find_bundles(str(log)) == [str(b)]
+    assert forensics.find_bundles(str(tele)) == [str(b)]

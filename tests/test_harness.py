@@ -14,6 +14,7 @@ from distributed_drift_detection_tpu.harness import (
     write_tables,
 )
 from distributed_drift_detection_tpu.results import read_results
+from conftest import needs_reference
 
 OUTDOOR = "/root/reference/outdoorStream.csv"
 
@@ -27,6 +28,7 @@ def base_cfg(tmp_path):
     )
 
 
+@needs_reference
 def test_grid_idempotent_resume(tmp_path):
     """The built-in crash recovery (C14): a second invocation runs nothing;
     deleting rows re-runs exactly the missing trials."""
@@ -51,6 +53,7 @@ def test_grid_idempotent_resume(tmp_path):
     assert n3 == 1
 
 
+@needs_reference
 def test_grid_spec_rule_warns_and_skips(tmp_path):
     """The notebook's per-dataset validity rule (Plot Results.ipynb cell 3)
     is code, not convention: off-spec (dataset, mult, partitions) cells warn
@@ -136,6 +139,7 @@ def test_append_projects_rows_onto_legacy_header(tmp_path):
     assert len(aggregate(df)) == 2
 
 
+@needs_reference
 def test_grid_detector_sweep_distinct_keys(tmp_path):
     """Sweeping detectors runs one trial set per detector, with distinct
     trial-identity keys so resume never conflates them (and DDM keeps the
@@ -160,6 +164,7 @@ def test_grid_detector_sweep_distinct_keys(tmp_path):
     assert n3 == 1
 
 
+@needs_reference
 def test_results_carry_attribution_columns(tmp_path):
     """Every run row records the quality axes (Hits/Spurious/Recall — the
     C11 schema extension), and the aggregator carries per-config means so
@@ -180,6 +185,7 @@ def test_results_carry_attribution_columns(tmp_path):
     assert (agg["mean_recall"] > 0).all()
 
 
+@needs_reference
 def test_grid_key_carries_execution_policy(tmp_path):
     """The W×R execution policy is part of every trial key: it changes the
     recorded Final Time for every model (and mlp/rf flags), so a policy
@@ -212,6 +218,7 @@ def test_grid_key_carries_execution_policy(tmp_path):
     assert n3 == 1  # changed policy: re-run
 
 
+@needs_reference
 def test_aggregate_and_tables(tmp_path):
     base = base_cfg(tmp_path)
     run_grid(base, mults=[1, 2], partitions=[1, 2], trials=2, progress=lambda *_: None)
@@ -233,6 +240,7 @@ def test_aggregate_and_tables(tmp_path):
         assert (tmp_path / name).exists()
 
 
+@needs_reference
 def test_render_all_figures(tmp_path):
     from distributed_drift_detection_tpu.harness.plots import render_all
 
@@ -243,6 +251,7 @@ def test_render_all_figures(tmp_path):
     assert (tmp_path / "figs" / "delay_pct.pdf").exists()
 
 
+@needs_reference
 def test_render_all_legacy_rows_get_readable_suffix(tmp_path):
     """Rows backfilled from pre-Model/Detector CSVs carry "-" placeholders;
     figure filenames must map them to 'legacy', not emit 'speedup-----.pdf'
@@ -273,6 +282,7 @@ def test_render_all_legacy_rows_get_readable_suffix(tmp_path):
     assert not any("---" in k for k in artifacts), sorted(artifacts)
 
 
+@needs_reference
 def test_argv_entry_point_reference_contract(tmp_path, monkeypatch, capsys):
     """python -m distributed_drift_detection_tpu URL INSTANCES MEMORY CORES
     TIME_STRING MULT_DATA [DATASET] — the reference's argv order

@@ -7,6 +7,7 @@ import jax
 
 from distributed_drift_detection_tpu import DDMParams, RunConfig, replace, run
 from distributed_drift_detection_tpu.io import planted_prototypes, stripe_partitions
+from conftest import needs_reference
 
 REF = DDMParams()
 OUTDOOR = "/root/reference/outdoorStream.csv"
@@ -49,6 +50,7 @@ def test_shuffle_chunk_invariance():
     np.testing.assert_array_equal(got, np.asarray(whole.rows))
 
 
+@needs_reference
 def test_host_shuffle_run_quality(tmp_path):
     """api.run with host shuffle: same detection quality as before (all 39
     boundaries per partition on the healthy geometry)."""

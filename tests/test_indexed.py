@@ -20,6 +20,7 @@ from distributed_drift_detection_tpu.io import (
     synthesize_stream,
 )
 from distributed_drift_detection_tpu.models import ModelSpec, build_model
+from conftest import needs_reference
 
 OUTDOOR = "/root/reference/outdoorStream.csv"
 
@@ -112,6 +113,7 @@ def test_window_indexed_row_table_computes_in_f32():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
 
 
+@needs_reference
 def test_api_run_uses_indexed_path_and_matches_dense():
     """End-to-end: api.run on a duplicated outdoorStream must produce the
     same flags/metrics whether the compressed path is taken (window>1) or

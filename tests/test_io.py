@@ -10,6 +10,8 @@ from distributed_drift_detection_tpu.io import (
     synthesize_stream,
 )
 
+from conftest import needs_reference
+
 OUTDOOR = "/root/reference/outdoorStream.csv"
 
 
@@ -39,6 +41,7 @@ def test_synthesize_subsample():
     assert s.num_rows == 50
 
 
+@needs_reference
 def test_outdoor_stream_geometry():
     """The shipped dataset: 4000 rows, 21 features, 40 equal concepts
     (SURVEY.md C16, verified empirically there)."""

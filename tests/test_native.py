@@ -7,6 +7,7 @@ import pytest
 
 from distributed_drift_detection_tpu.io import load_csv
 from distributed_drift_detection_tpu.io.native import load_csv_native, native_available
+from conftest import needs_reference
 
 OUTDOOR = "/root/reference/outdoorStream.csv"
 
@@ -37,6 +38,7 @@ def test_native_handles_crlf_and_no_trailing_newline(tmp_path):
     np.testing.assert_allclose(raw, [[1.5, 2.5, 0.0], [3.25, -4.5, 1.0]])
 
 
+@needs_reference
 def test_load_csv_uses_some_path():
     """load_csv works regardless of which backend parsed (native or numpy)."""
     X, y = load_csv(OUTDOOR)

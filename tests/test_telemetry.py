@@ -51,6 +51,14 @@ EXAMPLE_EVENTS = {
         attempt=1, max_attempts=3, reason="RuntimeError: device lost",
         backoff_s=0.55,
     ),
+    "span": dict(
+        name="kernel", trace_id="ab" * 16, span_id="cd" * 8,
+        parent_id=None, start_ts=1700000000.5, dur_s=0.012,
+    ),
+    "drift_forensics": dict(
+        chunk=2, partition=3, global_pos=1234,
+        bundle="run.forensics/drift-c2-p3-r1234.json",
+    ),
     "run_completed": dict(rows=2_048_000, seconds=0.16, detections=600),
 }
 
@@ -242,6 +250,68 @@ def test_prometheus_text_round_trips():
         == samples[
             ("phase_seconds_bucket", (("phase", "detect"), ("le", "+Inf")))
         ]
+    )
+
+
+def test_prometheus_help_and_type_for_every_series():
+    """Exposition-format conformance: every metric emits a `# HELP` and a
+    `# TYPE` line — including metrics registered with no help text (a
+    bare `# HELP name` line, never a skipped one)."""
+    reg = MetricsRegistry()
+    reg.counter("no_help_total").inc(1)  # registered WITHOUT help
+    reg.gauge("helped_gauge", help="has help").set(2.0)
+    reg.histogram("no_help_seconds").observe(0.1)
+    text = reg.to_prometheus_text()
+    lines = text.splitlines()
+    for name, kind in (
+        ("no_help_total", "counter"),
+        ("helped_gauge", "gauge"),
+        ("no_help_seconds", "histogram"),
+    ):
+        help_idx = next(
+            i for i, ln in enumerate(lines)
+            if ln == f"# HELP {name}" or ln.startswith(f"# HELP {name} ")
+        )
+        assert lines[help_idx + 1] == f"# TYPE {name} {kind}"
+    assert "# HELP no_help_total" in lines  # bare, no trailing space
+    # the parser still accepts the output (comments are transparent)
+    assert parse_prometheus_text(text)[("no_help_total", ())] == 1
+
+
+def test_prometheus_histogram_bucket_cumulativity_parsed():
+    """Parser-based `_bucket` conformance: cumulative counts are
+    non-decreasing over increasing `le`, `+Inf` equals `_count`, and
+    `_sum` matches — checked on the PARSED exposition text, the
+    scraper's view."""
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "lat_seconds", help="latency", buckets=(0.01, 0.1, 1.0, 10.0)
+    )
+    rng = np.random.default_rng(0)
+    values = rng.exponential(0.5, size=200)
+    for v in values:
+        h.observe(float(v), stage="total")
+    samples = parse_prometheus_text(reg.to_prometheus_text())
+    buckets = sorted(
+        (
+            float("inf") if dict(labels)["le"] == "+Inf"
+            else float(dict(labels)["le"]),
+            count,
+        )
+        for (name, labels) in samples
+        if name == "lat_seconds_bucket"
+        for count in [samples[(name, labels)]]
+    )
+    assert len(buckets) == 5  # 4 finite bounds + +Inf
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == samples[("lat_seconds_count", (("stage", "total"),))]
+    assert counts[-1] == 200
+    # each cumulative count equals the true number of values <= bound
+    for bound, count in buckets:
+        assert count == int((values <= bound).sum())
+    assert samples[("lat_seconds_sum", (("stage", "total"),))] == (
+        pytest.approx(float(values.sum()))
     )
 
 

@@ -1,0 +1,595 @@
+"""Trace plane: span schema, head sampling, wire propagation, the serving
+span chain, and the timeline CLI's Chrome-trace merge.
+
+The headline acceptance (ISSUE 11): a loadgen replay with sampling on
+yields at least one complete sampled trace whose span chain covers
+ingress → admission → batch → kernel → verdict, the merged
+``.trace.json`` validates as a Chrome trace, and with sampling off the
+hot path does no tracing work at all.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu import RunConfig
+from distributed_drift_detection_tpu.config import ServeParams
+from distributed_drift_detection_tpu.io import planted_prototypes
+from distributed_drift_detection_tpu.serve import ServeRunner
+from distributed_drift_detection_tpu.serve.loadgen import (
+    format_lines,
+    run_loadgen,
+    sample_traces,
+)
+from distributed_drift_detection_tpu.telemetry import tracing
+from distributed_drift_detection_tpu.telemetry.events import (
+    EventLog,
+    SchemaError,
+    read_events,
+    validate_event,
+)
+from distributed_drift_detection_tpu.telemetry.timeline import (
+    TimelineError,
+    build_timeline,
+    validate_chrome_trace,
+)
+
+
+# --- schema round-trip (span + drift_forensics) ----------------------------
+
+
+def _emit_and_read(tmp_path, etype, **fields):
+    log = EventLog(str(tmp_path / "roundtrip.jsonl"))
+    log.emit(etype, **fields)
+    log.close()
+    (event,) = read_events(log.path)
+    return event
+
+
+def test_span_event_schema_round_trip(tmp_path):
+    event = _emit_and_read(
+        tmp_path,
+        "span",
+        name="kernel",
+        trace_id=tracing.new_trace_id(),
+        span_id=tracing.new_span_id(),
+        parent_id=None,  # root spans: nullable by contract
+        start_ts=123.5,
+        dur_s=0.25,
+        chunk=7,  # extra fields ride through (forward compat)
+    )
+    assert event["name"] == "kernel" and event["chunk"] == 7
+    assert event["parent_id"] is None
+
+
+def test_drift_forensics_event_schema_round_trip(tmp_path):
+    event = _emit_and_read(
+        tmp_path,
+        "drift_forensics",
+        chunk=3,
+        partition=1,
+        global_pos=588,
+        bundle="run.forensics/drift-c3-p1-r588.json",
+        tenant=0,  # extra field tolerated
+    )
+    assert event["global_pos"] == 588 and event["tenant"] == 0
+
+
+@pytest.mark.parametrize(
+    "etype,fields",
+    [
+        ("span", dict(name="x", trace_id="a", span_id="b", parent_id=None,
+                      start_ts=1.0)),  # dur_s missing
+        ("span", dict(name="x", trace_id="a", span_id="b", start_ts=1.0,
+                      dur_s=0.1)),  # parent_id missing entirely
+        ("drift_forensics", dict(chunk=1, partition=0, global_pos=5)),
+        # null where not nullable:
+        ("drift_forensics", dict(chunk=1, partition=0, global_pos=None,
+                                 bundle="b.json")),
+        ("span", dict(name="x", trace_id=None, span_id="b", parent_id=None,
+                      start_ts=1.0, dur_s=0.1)),
+    ],
+)
+def test_new_event_types_reject_missing_or_null_required(tmp_path, etype, fields):
+    log = EventLog(str(tmp_path / "bad.jsonl"))
+    with pytest.raises(SchemaError):
+        log.emit(etype, **fields)
+    log.close()
+    # the refused emit left nothing behind (producer-side validation)
+    assert read_events(log.path) == []
+
+
+def test_span_extra_fields_tolerated_by_reader():
+    validate_event(
+        {
+            "v": 1, "type": "span", "ts": 1.0, "seq": 0,
+            "name": "serve", "trace_id": "t", "span_id": "s",
+            "parent_id": "p", "start_ts": 1.0, "dur_s": 0.5,
+            "some_future_field": {"nested": True},
+        }
+    )
+
+
+# --- head sampling ---------------------------------------------------------
+
+
+def test_head_sampler_rate_zero_is_falsy_and_samples_nothing():
+    s = tracing.HeadSampler(0.0)
+    assert not s
+    assert s.sample() is False
+    assert s.sample_block(1000) == []
+
+
+def test_head_sampler_rate_one_samples_everything():
+    s = tracing.HeadSampler(1.0)
+    assert s and s.sample()
+    assert s.sample_block(5) == [0, 1, 2, 3, 4]
+
+
+def test_head_sampler_seeded_and_rate_respected():
+    a = tracing.HeadSampler(0.3, seed=42)
+    b = tracing.HeadSampler(0.3, seed=42)
+    got_a, got_b = a.sample_block(10_000), b.sample_block(10_000)
+    assert got_a == got_b  # deterministic under a seed
+    assert 0.2 < len(got_a) / 10_000 < 0.4
+
+
+def test_trace_token_validation():
+    tracing.check_trace_token(tracing.new_trace_id())
+    tracing.check_trace_token(tracing.new_span_id())
+    for bad in ("", "UPPER", "has space", "x" * 65, "nonhex-!"):
+        with pytest.raises(ValueError):
+            tracing.check_trace_token(bad)
+
+
+def test_loadgen_sample_traces_rate_zero_empty():
+    assert sample_traces(100, 0.0) == {}
+    ctx = sample_traces(100, 1.0, seed=1)
+    assert len(ctx) == 100
+    tid, sid = ctx[0]
+    assert len(tid) == 32 and len(sid) == 16
+
+
+# --- the serving span chain ------------------------------------------------
+
+
+def test_emit_row_spans_chain_and_parenting(tmp_path):
+    log = EventLog(str(tmp_path / "spans.jsonl"))
+    ingest = np.array([100.0, 100.5, 101.0])
+    meta = {
+        "chunk": 4,
+        "traces": [
+            {"idx": 0, "trace_id": "a" * 32, "parent_id": "b" * 16},
+            {"idx": 2, "trace_id": "c" * 32, "parent_id": None,
+             "tenant": 1},
+        ],
+        "ingest_mono": ingest,
+        "sealed_mono": 101.5,
+        "fed_mono": 101.6,
+    }
+    ids = tracing.emit_row_spans(
+        log, meta, collected_mono=101.9, published_mono=102.0
+    )
+    log.close()
+    assert ids == ["a" * 32, "c" * 32]
+    events = read_events(log.path)
+    by_trace = {}
+    for e in events:
+        assert e["type"] == "span" and e["dur_s"] >= 0
+        by_trace.setdefault(e["trace_id"], []).append(e)
+    assert set(by_trace) == {"a" * 32, "c" * 32}
+    for tid, spans in by_trace.items():
+        names = [s["name"] for s in spans]
+        assert names == ["serve", *tracing.ROW_STAGES]
+        serve = spans[0]
+        # stage spans parent to the serve span; serve parents to the wire
+        for child in spans[1:]:
+            assert child["parent_id"] == serve["span_id"]
+    assert by_trace["a" * 32][0]["parent_id"] == "b" * 16
+    assert by_trace["c" * 32][0]["parent_id"] is None
+    assert all(s["tenant"] == 1 for s in by_trace["c" * 32])
+    # durations decompose: serve covers ingest -> published
+    serve = by_trace["a" * 32][0]
+    assert serve["dur_s"] == pytest.approx(102.0 - 100.0)
+
+
+def _serve(seed, tmp_path, trace_sample=0.0, **cfg_kw):
+    stream = planted_prototypes(seed, concepts=3, rows_per_concept=480,
+                                features=7)
+    cfg = RunConfig(
+        partitions=4, per_batch=50, model="centroid", shuffle_batches=True,
+        results_csv="", seed=seed, window=1, data_policy="quarantine",
+        telemetry_dir=str(tmp_path / "tele"), **cfg_kw,
+    )
+    params = ServeParams(
+        num_features=stream.num_features, num_classes=stream.num_classes,
+        port=0, chunk_batches=2, linger_s=0.05, trace_sample=trace_sample,
+    )
+    runner = ServeRunner(cfg, params, keep_flags=True)
+    banner = runner.start()
+    t = threading.Thread(target=runner.serve_forever)
+    t.start()
+    return stream, runner, banner, t
+
+
+def test_socket_traced_replay_end_to_end(tmp_path, monkeypatch):
+    """The acceptance: a sampled loadgen replay yields complete traces
+    whose span chain covers ingress→admission→batch→kernel→verdict,
+    verdicts join back to their packets, and the merged timeline is a
+    valid Chrome trace."""
+    monkeypatch.chdir(tmp_path)
+    stream, runner, banner, t = _serve(12, tmp_path)
+    lines = format_lines(stream.X, stream.y)
+    clog = EventLog.open_run(str(tmp_path / "tele"), name="loadgen")
+    clog.emit("run_started", run_id=clog.run_id, config={"kind": "loadgen"})
+    rep = run_loadgen(
+        banner["host"], banner["port"], lines, rate=0.0,
+        verdicts=banner["verdicts"], timeout=120, stop=True,
+        trace_sample=0.1, trace_seed=3, trace_log=clog,
+    )
+    t.join(timeout=120)
+    assert not t.is_alive()
+    clog.emit("run_completed", rows=rep["rows_sent"], seconds=1.0,
+              detections=rep["detections"])
+    clog.close()
+    assert not rep["timeout"] and rep["rows_covered"] == len(lines)
+    assert rep["rows_traced"] > 0
+    assert rep["traces_covered"] == rep["rows_traced"]  # all joined back
+
+    # daemon side: every traced row has the full chain
+    events = read_events(banner["run_log"])
+    chains = {}
+    for e in events:
+        if e["type"] == "span":
+            chains.setdefault(e["trace_id"], []).append(e["name"])
+    assert len(chains) == rep["rows_traced"]
+    for names in chains.values():
+        assert names == ["serve", *tracing.ROW_STAGES]
+
+    # client side: one root ingress span per covered trace, same ids
+    client_spans = [
+        e for e in read_events(clog.path) if e["type"] == "span"
+    ]
+    assert len(client_spans) == rep["rows_traced"]
+    assert {s["trace_id"] for s in client_spans} == set(chains)
+    assert all(
+        s["name"] == "ingress" and s["parent_id"] is None
+        for s in client_spans
+    )
+
+    # verdict records name the trace ids they cover
+    from distributed_drift_detection_tpu.serve import read_verdicts
+
+    verd_traces = set()
+    for rec in read_verdicts(banner["verdicts"]):
+        verd_traces.update(rec.get("traces") or [])
+    assert verd_traces == set(chains)
+
+    # statusz counts the traced rows
+    st = runner._statusz()
+    assert st["tracing"]["rows_traced"] == rep["rows_traced"]
+
+    # timeline: daemon + client logs merge into a valid Chrome trace
+    trace = build_timeline([banner["run_log"], clog.path])
+    n = validate_chrome_trace(trace)
+    assert n > 0
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    ingress = [e for e in slices if e["name"] == "ingress"]
+    kernels = [e for e in slices if e["name"] == "kernel"]
+    assert ingress and kernels
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+    out = tmp_path / "merged.trace.json"
+    out.write_text(json.dumps(trace))
+    validate_chrome_trace(json.loads(out.read_text()))
+
+
+def test_sampling_off_leaves_no_trace_artifacts(tmp_path, monkeypatch):
+    """rate 0 = zero trace output: no spans in the log, no traces field
+    on any verdict, no trace work counted."""
+    monkeypatch.chdir(tmp_path)
+    stream, runner, banner, t = _serve(5, tmp_path)
+    lines = format_lines(stream.X, stream.y)
+    rep = run_loadgen(
+        banner["host"], banner["port"], lines, rate=0.0,
+        verdicts=banner["verdicts"], timeout=120, stop=True,
+    )
+    t.join(timeout=120)
+    assert not t.is_alive() and not rep["timeout"]
+    assert rep["rows_traced"] == 0
+    events = read_events(banner["run_log"])
+    assert not [e for e in events if e["type"] == "span"]
+    from distributed_drift_detection_tpu.serve import read_verdicts
+
+    assert all(
+        "traces" not in rec for rec in read_verdicts(banner["verdicts"])
+    )
+    assert runner._statusz()["tracing"]["rows_traced"] == 0
+
+
+def test_daemon_side_sampling_of_unstamped_rows(tmp_path, monkeypatch):
+    """ServeParams.trace_sample samples rows the client never stamped:
+    fresh root traces, full chains."""
+    monkeypatch.chdir(tmp_path)
+    stream, runner, banner, t = _serve(7, tmp_path, trace_sample=1.0)
+    lines = format_lines(stream.X, stream.y)
+    rep = run_loadgen(
+        banner["host"], banner["port"], lines, rate=0.0,
+        verdicts=banner["verdicts"], timeout=120, stop=True,
+    )
+    t.join(timeout=120)
+    assert not t.is_alive() and not rep["timeout"]
+    chains = {}
+    for e in read_events(banner["run_log"]):
+        if e["type"] == "span":
+            chains.setdefault(e["trace_id"], []).append(e["name"])
+    assert len(chains) == len(lines)  # rate 1.0: every row traced
+    assert all(
+        names == ["serve", *tracing.ROW_STAGES] for names in chains.values()
+    )
+
+
+def test_ingress_rejects_malformed_trace_line(tmp_path, monkeypatch):
+    """A malformed TRACE wire line is untrusted client input: ERR + drop
+    THIS connection, daemon keeps serving (the TENANT contract)."""
+    import socket
+
+    monkeypatch.chdir(tmp_path)
+    stream, runner, banner, t = _serve(9, tmp_path)
+    lines = format_lines(stream.X, stream.y)
+    with socket.create_connection(
+        (banner["host"], banner["port"]), timeout=10
+    ) as sock:
+        sock.sendall(b"TRACE not-hex!\n" + (lines[0] + "\n").encode())
+        reply = sock.recv(1024)
+    assert reply.startswith(b"ERR ")
+    # the daemon survived: a fresh connection still serves
+    rep = run_loadgen(
+        banner["host"], banner["port"], lines, rate=0.0,
+        verdicts=banner["verdicts"], timeout=120, stop=True,
+    )
+    t.join(timeout=120)
+    assert not t.is_alive() and not rep["timeout"]
+    assert rep["rows_covered"] == len(lines)
+
+
+def test_multi_tenant_traced_replay(tmp_path, monkeypatch):
+    """TRACE stamps survive the TENANT wire routing: spans carry the
+    tenant, per-tenant attribution still joins every trace back."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(6, concepts=3, rows_per_concept=480,
+                                features=6)
+    cfg = RunConfig(
+        partitions=2, per_batch=50, tenants=2, model="centroid",
+        shuffle_batches=True, results_csv="", seed=6, window=1,
+        data_policy="quarantine", telemetry_dir=str(tmp_path / "tele"),
+    )
+    params = ServeParams(
+        num_features=6, num_classes=3, port=0, chunk_batches=2,
+        linger_s=0.05,
+    )
+    runner = ServeRunner(cfg, params, keep_flags=True)
+    banner = runner.start()
+    t = threading.Thread(target=runner.serve_forever)
+    t.start()
+    lines = format_lines(stream.X, stream.y)
+    rep = run_loadgen(
+        banner["host"], banner["port"], lines, rate=0.0,
+        verdicts=banner["verdicts"], timeout=120, stop=True, tenants=2,
+        trace_sample=0.1, trace_seed=5,
+    )
+    t.join(timeout=120)
+    assert not t.is_alive() and not rep["timeout"]
+    assert rep["rows_traced"] > 0
+    assert rep["traces_covered"] == rep["rows_traced"]
+    chains = {}
+    tenants_seen = set()
+    for e in read_events(banner["run_log"]):
+        if e["type"] == "span":
+            chains.setdefault(e["trace_id"], []).append(e["name"])
+            tenants_seen.add(e.get("tenant"))
+    assert len(chains) == rep["rows_traced"]
+    assert all(
+        names == ["serve", *tracing.ROW_STAGES] for names in chains.values()
+    )
+    assert tenants_seen == {0, 1}  # both tenant slots produced traces
+
+
+# --- batch-pipeline tracer (ChunkTracer) -----------------------------------
+
+
+def test_chunk_tracer_falsy_forms_emit_nothing(tmp_path):
+    assert not tracing.ChunkTracer(None, rate=1.0)
+    log = EventLog(str(tmp_path / "t.jsonl"))
+    assert not tracing.ChunkTracer(log, rate=0.0)
+    tr = tracing.ChunkTracer(log, rate=0.0)
+    assert tr.span("kernel", 0, 0.0, 1.0) is None
+    log.close()
+    assert read_events(log.path) == []
+
+
+def test_chunk_tracer_spans_share_trace_and_root(tmp_path):
+    log = EventLog(str(tmp_path / "t.jsonl"))
+    tr = tracing.ChunkTracer(log, rate=1.0, seed=0)
+    a = tr.span("ingest", 0, 10.0, 10.5, rows=100)
+    b = tr.span("kernel", 0, 10.5, 11.0)
+    c = tr.span("ingest", 1, 11.0, 11.5, rows=100)
+    log.close()
+    events = read_events(log.path)
+    assert [e["name"] for e in events] == ["ingest", "kernel", "ingest"]
+    # one trace per CHUNK: chunk 0's two stages share one, chunk 1 is new
+    assert events[0]["trace_id"] == events[1]["trace_id"]
+    assert events[2]["trace_id"] != events[0]["trace_id"]
+    assert events[0]["span_id"] == a and events[0]["parent_id"] is None
+    assert events[1]["span_id"] == b and events[1]["parent_id"] == a
+    assert events[2]["span_id"] == c and events[2]["parent_id"] is None
+
+
+def test_chunked_cli_trace_sample_emits_pipeline_spans(tmp_path, monkeypatch):
+    """--trace-sample on the chunked CLI: ingest + kernel spans land in
+    the run log and the timeline CLI renders them."""
+    from distributed_drift_detection_tpu.harness.chunked_cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(0)
+    n, f = 900, 4
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (np.arange(n) // 300) % 3
+    csv = tmp_path / "s.csv"
+    header = ",".join(f"f{i}" for i in range(f)) + ",target"
+    rows = "\n".join(
+        ",".join(repr(float(v)) for v in X[i]) + f",{y[i]}" for i in range(n)
+    )
+    csv.write_text(header + "\n" + rows + "\n")
+    tele = tmp_path / "tele"
+    main([
+        str(csv), "--classes", "3", "--partitions", "2", "--per-batch", "25",
+        "--chunk-batches", "4", "--window", "1", "--telemetry-dir", str(tele),
+        "--trace-sample", "1.0",
+    ])
+    import glob
+    import os
+
+    from distributed_drift_detection_tpu.telemetry.registry import INDEX_NAME
+
+    (log_path,) = [
+        p
+        for p in glob.glob(str(tele / "*.jsonl"))
+        if os.path.basename(p) != INDEX_NAME
+        and ".quarantine." not in p
+    ]
+    spans = [e for e in read_events(log_path) if e["type"] == "span"]
+    names = {e["name"] for e in spans}
+    assert names == {"ingest", "kernel"}
+    # one trace per chunk: its ingest + kernel stages share it, and no
+    # two chunks collide on one trace (separate timeline lanes)
+    by_chunk = {}
+    traces_by_chunk = {}
+    for e in spans:
+        by_chunk.setdefault(e["chunk"], set()).add(e["name"])
+        traces_by_chunk.setdefault(e["chunk"], set()).add(e["trace_id"])
+    assert all(v == {"ingest", "kernel"} for v in by_chunk.values())
+    assert all(len(v) == 1 for v in traces_by_chunk.values())
+    all_traces = [next(iter(v)) for v in traces_by_chunk.values()]
+    assert len(set(all_traces)) == len(all_traces)
+    trace = build_timeline([log_path])
+    assert validate_chrome_trace(trace) > 0
+
+
+# --- timeline clock alignment ----------------------------------------------
+
+
+def _synthetic_log(
+    tmp_path, name, t0, process_index, config, events, process_count=2
+):
+    """Write a schema-valid per-process run log with a fixed clock."""
+    clock_holder = {"now": t0}
+    log = EventLog(
+        str(tmp_path / f"{name}.jsonl"),
+        clock=lambda: clock_holder["now"],
+    )
+    ident = (
+        {"process_index": process_index, "process_count": process_count}
+        if process_count
+        else {}
+    )
+    log.emit(
+        "run_started", run_id=name, config=config, hostname=name, **ident,
+    )
+    for dt, etype, fields in events:
+        clock_holder["now"] = t0 + dt
+        log.emit(etype, **fields)
+    log.close()
+    return log.path
+
+
+def test_timeline_clock_skew_alignment(tmp_path):
+    """Satellite: two per-process logs of ONE run with a known wall-clock
+    offset merge into one monotonic, skew-rebased trace — same-program
+    events land at the same timeline instant."""
+    config = {"dataset": "synth", "seed": 1}
+    shared = [
+        (1.0, "phase_completed", {"phase": "detect", "seconds": 0.5}),
+        (2.0, "chunk_completed",
+         {"chunk": 0, "batches_done": 4, "detections": 1}),
+        (3.0, "run_completed", {"rows": 100, "seconds": 3.0, "detections": 1}),
+    ]
+    skew = 500.0  # proc1's wall clock is 500 s ahead
+    a = _synthetic_log(tmp_path, "proc0", 1000.0, 0, config, shared)
+    b = _synthetic_log(tmp_path, "proc1", 1000.0 + skew, 1, config, shared)
+    trace = build_timeline([a, b])
+    validate_chrome_trace(trace)
+    per_pid = {}
+    for e in trace["traceEvents"]:
+        if e["ph"] == "M":
+            continue
+        per_pid.setdefault(e["pid"], []).append(e)
+    assert set(per_pid) == {0, 1}
+    # monotonic within each process and ALIGNED across them: the skew
+    # cancelled exactly, so the same program points coincide
+    for pid, evs in per_pid.items():
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+    t_a = {e["name"]: e["ts"] for e in per_pid[0]}
+    t_b = {e["name"]: e["ts"] for e in per_pid[1]}
+    assert set(t_a) == set(t_b)
+    for name in t_a:
+        assert t_a[name] == pytest.approx(t_b[name], abs=1.0), name
+
+
+def test_timeline_wall_clock_placement_for_distinct_programs(tmp_path):
+    """Logs with different config digests (daemon + loadgen) sit on the
+    shared wall clock: their relative offset is preserved, not rebased."""
+    a = _synthetic_log(
+        tmp_path, "daemon", 1000.0, 0, {"kind": "serve"},
+        [(1.0, "heartbeat", {"rows_done": 10, "elapsed_s": 1.0})],
+    )
+    b = _synthetic_log(
+        tmp_path, "client", 1010.0, 0, {"kind": "loadgen"},
+        [(1.0, "heartbeat", {"rows_done": 10, "elapsed_s": 1.0})],
+    )
+    trace = build_timeline([a, b])
+    starts = {
+        e["args"]["run_id"]: e["ts"]
+        for e in trace["traceEvents"]
+        if e["name"] == "run_started"
+    }
+    # the client started 10 s after the daemon, and the merge says so
+    assert (starts["client"] - starts["daemon"]) == pytest.approx(
+        10.0 * 1e6, abs=1e3
+    )
+
+
+def test_timeline_repeated_runs_of_one_config_stay_on_wall_clock(tmp_path):
+    """Two independent runs of one config (same digest, no declared
+    multi-process identity — e.g. two identical loadgen replays) must
+    NOT be skew-rebased onto a common origin: they are not one run, and
+    their real 100 s separation is the signal."""
+    config = {"kind": "loadgen", "source": "synth"}
+    ev = [(1.0, "heartbeat", {"rows_done": 10, "elapsed_s": 1.0})]
+    a = _synthetic_log(tmp_path, "replay1", 1000.0, 0, config, ev,
+                       process_count=None)
+    b = _synthetic_log(tmp_path, "replay2", 1100.0, 0, config, ev,
+                       process_count=None)
+    trace = build_timeline([a, b])
+    starts = {
+        e["args"]["run_id"]: e["ts"]
+        for e in trace["traceEvents"]
+        if e["name"] == "run_started"
+    }
+    assert (starts["replay2"] - starts["replay1"]) == pytest.approx(
+        100.0 * 1e6, abs=1e3
+    )
+
+
+def test_timeline_rejects_garbage():
+    with pytest.raises(TimelineError):
+        validate_chrome_trace({"nope": 1})
+    with pytest.raises(TimelineError):
+        validate_chrome_trace(
+            {"traceEvents": [{"name": "x", "ph": "X", "pid": 0, "tid": 0,
+                              "ts": 0.0}]}  # X without dur
+        )
+    with pytest.raises(TimelineError):
+        build_timeline([])

@@ -15,6 +15,8 @@ from distributed_drift_detection_tpu.utils.validate import (
     validate_flag_rows,
 )
 
+from conftest import needs_reference
+
 
 def test_checked_window_accepts_valid_input():
     rng = np.random.default_rng(0)
@@ -90,6 +92,7 @@ def test_flag_audit_catches_corruption(corrupt, msg):
         validate_flag_rows(f, num_batches=9, per_batch=10, num_rows=90)
 
 
+@needs_reference
 def test_api_run_with_validation():
     """End-to-end: validate=True audits the real flag table silently."""
     res = run(
