@@ -71,7 +71,11 @@ bench — an in-process ``serve`` daemon on a loopback socket, warmed
 (AOT prepare + one warm-up replay), then driven by the loadgen at RATE
 rows/s — and emits ``serve_rows_per_sec`` with ``serve_p50_ms`` /
 ``serve_p99_ms`` row→verdict latency (tracked informationally by the
-``perf`` CLI).
+``perf`` CLI). Round-12 rider: the same mode measures the adaptation
+plane — a second in-process daemon with ``on_drift=retrain`` consumes a
+planted recurring-drift stream and emits ``serve_adapt_recovery_rows``
+(rows from drift verdict until post-drift error returns within ε of the
+pre-drift level; informational).
 """
 
 import json
@@ -1056,6 +1060,65 @@ def _serve_stats(
     }
 
 
+def _adapt_stats(rows: int = 4800) -> dict:
+    """``--serve`` rider: the adaptation-recovery bench. An in-process
+    daemon with ``on_drift=retrain`` consumes a planted recurring-drift
+    stream (``io.synth.recurring_drift_xy`` — per-concept class
+    prototypes, so the stale model measurably fails on each boundary)
+    and the adapt plane's own recovery watch measures
+    ``serve_adapt_recovery_rows``: rows from the drift verdict until
+    post-drift chunk error returns within the policy's epsilon of the
+    pre-drift running level. Informational in the perf CLI — recovery
+    spans move with the stream geometry; correctness is owned by
+    tests/test_adapt.py and the adapt-smoke CI job."""
+    from distributed_drift_detection_tpu.config import RunConfig, ServeParams
+    from distributed_drift_detection_tpu.io.synth import recurring_drift_xy
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    concepts = max(rows // 1200, 2)
+    X, y = recurring_drift_xy(
+        seed=1, concepts=concepts, rows_per_concept=rows // concepts
+    )
+    cfg = RunConfig(
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        window=1,
+        data_policy="quarantine",
+        results_csv="",
+        compile_cache_dir=_CLI["compile_cache_dir"]
+        or os.path.join(_BENCH_DIR, ".jax_cache"),
+    )
+    params = ServeParams(
+        num_features=int(X.shape[1]),
+        num_classes=int(y.max()) + 1,
+        port=None,  # in-process embedding: admission driven directly
+        chunk_batches=2,
+        linger_s=0.05,
+        slo=("none",),
+        on_drift=("retrain",),
+    )
+    runner = ServeRunner(cfg, params)
+    runner.start()
+    lines = format_lines(X, y)
+    for i in range(0, len(lines), 200):
+        runner.admission.admit_lines(lines[i : i + 200])
+    runner.batcher.flush()
+    runner.request_stop()
+    drained = runner.serve_forever() == 0
+    adapt = runner._adapt
+    snap = adapt.snapshot() if adapt is not None else {}
+    return {
+        "serve_adapt_rows": len(lines),
+        "serve_adaptations": snap.get("adaptations", 0),
+        "serve_adapt_recovery_rows": (
+            adapt.recovery_rows() if adapt is not None else None
+        ),
+        "serve_adapt_drained": drained,
+    }
+
+
 def serve_bench(rows: int, rate: float, tenants: int = 1) -> None:
     import jax
 
@@ -1066,6 +1129,7 @@ def serve_bench(rows: int, rate: float, tenants: int = 1) -> None:
                 "metric": "serve_row_to_verdict",
                 "unit": "ms",
                 **_serve_stats(rows, rate, tenants),
+                **_adapt_stats(),
                 "device": str(jax.devices()[0].platform),
             }
         )

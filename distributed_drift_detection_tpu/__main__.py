@@ -38,9 +38,14 @@ ingress sanitized at admission (strict|quarantine|repair), microbatched
 into fixed-geometry chunks, detected by the AOT-warmed chunked engine,
 verdicts + heartbeats published through the telemetry registry so
 ``watch``/``report`` work unchanged on the live service; SIGTERM drains
-and checkpoints. ``loadgen`` replays an ``io/synth`` spec or CSV at a
-target rows/s (optionally with seeded dirty rows) and reports achieved
-rate + p50/p99 row→verdict latency as JSON.
+and checkpoints. ``--on-drift retrain|shadow`` additionally *consumes*
+the verdicts (adapt/ subsystem, docs/SERVING.md "Adaptation"):
+per-tenant post-drift window refit hot-swapped at a chunk boundary with
+zero recompiles, champion/challenger gating, `adaptation` events.
+``loadgen`` replays an ``io/synth`` spec or CSV at a target rows/s
+(optionally with seeded dirty rows, or with ``--delayed-labels K``
+label lag) and reports achieved rate + p50/p99 row→verdict latency as
+JSON.
 
 A ``chunked`` subcommand drives the streaming ingest pipeline end to end
 on a CSV (``harness.chunked_cli``): mmap'd line-aligned blocks fan out to

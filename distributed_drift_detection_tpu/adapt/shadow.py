@@ -1,0 +1,101 @@
+"""Champion/challenger shadow scoring for the adaptation plane.
+
+Under ``on_drift=shadow`` the stale model (the **champion**) is not
+swapped out on a drift verdict: a **challenger** is refitted on the
+post-drift window and both are scored **in one compiled plane** — the
+pair of per-partition parameter pytrees is stacked on a leading ``side``
+axis and the predict runs ``vmap(side) ∘ vmap(partition)`` in a single
+jitted program, so champion and challenger see exactly the same rows at
+exactly the same cost as two independent evaluations would dispatch.
+Promotion is gated on the measured shadow-slice error (the challenger
+must beat the champion by more than ``AdaptPolicy.margin``); after a
+promotion the deposed champion is retained host-side for one probation
+window, and if the challenger *regresses* against it there the swap is
+reverted (demotion).
+
+All programs here have static shapes fixed at construction (window
+length, partition count, feature width), so the whole shadow plane
+compiles exactly once per daemon — the serving kernel is untouched and
+the PR-6 AOT/compile-cache counters stay flat (pinned by test).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_sides(champion, challenger):
+    """Stack two per-partition param pytrees on a leading ``side`` axis
+    (side 0 = champion, side 1 = challenger) — the pair scorer's input."""
+    return jax.tree.map(
+        lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]),
+        champion,
+        challenger,
+    )
+
+
+def make_pair_scorer(model):
+    """Build the jitted shadow scorer:
+    ``(stacked_params [S, P, ...], X [W, F], y [W], w [W]) -> err [S]``.
+
+    Every side's every partition scores the same window; a side's error
+    is the validity-weighted mean mis-prediction rate pooled over its
+    partitions (each partition of a tenant carries its own evolved
+    params, so the pool is the honest per-tenant number). ``w`` masks
+    window padding. An all-masked window returns 0-weight errors of 0 —
+    callers treat ``n == 0`` as "no evidence" (:func:`pair_errors`).
+    """
+
+    def _side(params_p, X, y, w):
+        # vmap over partitions: each partition's params predict the rows
+        preds = jax.vmap(model.predict, in_axes=(0, None))(params_p, X)
+        errs = (preds != y[None, :]).astype(jnp.float32) * w[None, :]
+        return jnp.sum(errs), jnp.float32(preds.shape[0]) * jnp.sum(w)
+
+    def score(stacked, X, y, w):
+        err_sum, n = jax.vmap(_side, in_axes=(0, None, None, None))(
+            stacked, X, y, w
+        )
+        return err_sum / jnp.maximum(n, 1.0), n
+
+    return jax.jit(score)
+
+
+def pair_errors(scorer, champion, challenger, X, y, w):
+    """Score a champion/challenger pair on one window; returns
+    ``(err_champion, err_challenger)`` as floats, or ``(None, None)``
+    when the window carries no valid rows."""
+    err, n = scorer(stack_sides(champion, challenger), X, y, w)
+    err = jax.device_get(err)
+    n = jax.device_get(n)
+    if float(n[0]) <= 0.0:
+        return None, None
+    return float(err[0]), float(err[1])
+
+
+def should_promote(
+    err_champion: "float | None",
+    err_challenger: "float | None",
+    margin: float,
+) -> bool:
+    """The promotion gate: the challenger must *measurably* beat the
+    champion on the shadow slice. No evidence (empty window) keeps the
+    champion — a swap must never ride on zero rows."""
+    if err_champion is None or err_challenger is None:
+        return False
+    return err_challenger < err_champion - margin
+
+
+def should_demote(
+    err_champion: "float | None",
+    err_challenger: "float | None",
+    margin: float,
+) -> bool:
+    """The probation gate after a promotion: demote (restore the old
+    champion) only when it *measurably* beats the challenger on the
+    probation window — ties and missing evidence keep the challenger
+    (the promotion already carried its own measured justification)."""
+    if err_champion is None or err_challenger is None:
+        return False
+    return err_champion < err_challenger - margin

@@ -329,6 +329,14 @@ class ServeParams(NamedTuple):
     # Requires a telemetry dir (bundles anchor to the run log's stem);
     # False disables capture entirely.
     forensics: bool = True
+    # --- adaptation plane (adapt/ subsystem) ---
+    # Per-tenant drift-reaction policy specs (adapt.policy grammar; the
+    # CLI's repeatable --on-drift). Each spec is `POLICY[,k=v...]`
+    # plane-wide or `T=POLICY[,k=v...]` per tenant, POLICY one of
+    # alert_only|retrain|shadow. Empty (the default) = alert_only for
+    # every tenant: verdicts only publish — today's behaviour,
+    # byte-identical (no adaptation code runs at all).
+    on_drift: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)

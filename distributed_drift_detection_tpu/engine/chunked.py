@@ -103,6 +103,7 @@ class ChunkedDetector:
         donate: bool = True,
         tenants: int = 1,
         tenant_seeds=None,
+        on_drift=None,
     ):
         # ``shuffle`` here is the *in-jit* per-batch shuffle; the preferred
         # (device-free and api.run-compatible) route is stripe-time shuffling:
@@ -264,6 +265,15 @@ class ChunkedDetector:
         # step mid-run cannot fake progress for the `watch` CLI.
         self.rows_done = 0
         self._feed_started: float | None = None
+        # Adaptation hook (adapt/ subsystem): the offline chunked loop's
+        # twin of the serving daemon's --on-drift routing, so the paper's
+        # batch loop and the live daemon share ONE adaptation code path.
+        # Accepts a policy spec string (adapt.policy grammar), a list of
+        # specs, or a ready AdaptationController; resolved lazily on the
+        # first drained chunk (the controller needs the chunk geometry).
+        # None (default) = today's behaviour, no adaptation code runs.
+        self._on_drift = on_drift
+        self.adapt = None  # the resolved AdaptationController (or None)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -578,9 +588,11 @@ class ChunkedDetector:
 
         it = iter(chunks)
         nxt = next(it, None)
+        host_chunk = nxt  # the numpy-backed copy (adaptation window rows)
         placed = _place_timed(nxt)
         i = 0
         while placed is not None:
+            cur_host = host_chunk
             t_feed = _time.perf_counter()
             t_feed_mono = _time.monotonic()
             flags = self.feed(placed)
@@ -589,7 +601,26 @@ class ChunkedDetector:
             # Double-buffer: dispatch chunk k+1's upload (and pay its host
             # parse/stripe cost) while chunk k computes.
             nxt = next(it, None)
+            host_chunk = nxt
             placed = _place_timed(nxt)
+            if self._on_drift is not None:
+                self._ensure_adapt(cur_host, telemetry)
+            if self.adapt is not None and self.adapt.active:
+                # The adaptation hook consumes HOST flags, so this chunk
+                # syncs here instead of at the group boundary — the
+                # documented cost of reacting (vs only reporting) on the
+                # offline path; the dispatch pipeline itself is unchanged.
+                flags = jax.tree.map(np.asarray, flags)
+                per_tenant_rows = self.rows_done // self.tenants
+                self.adapt.on_chunk(
+                    {
+                        "chunk": i,
+                        "rows_through": self.rows_done,
+                        "t_rows_through": [per_tenant_rows] * self.tenants,
+                    },
+                    flags,
+                    cur_host,
+                )
             if telemetry is not None:
                 flags, _ = self.emit_chunk_event(telemetry, i, flags, metrics)
                 self.emit_heartbeat(telemetry)
@@ -628,6 +659,35 @@ class ChunkedDetector:
                 self.rows_done,
             )
         return flags
+
+    def _ensure_adapt(self, chunk, telemetry) -> None:
+        """Resolve the ``on_drift`` hook into a live controller on the
+        first drained chunk (policy specs need the chunk geometry a
+        detector does not know until data arrives). Idempotent."""
+        if self.adapt is not None or self._on_drift is None:
+            return
+        from ..adapt.refit import AdaptationController
+
+        if isinstance(self._on_drift, AdaptationController):
+            self.adapt = self._on_drift
+            return
+        from ..adapt.policy import resolve_policies
+
+        specs = (
+            [self._on_drift]
+            if isinstance(self._on_drift, str)
+            else list(self._on_drift)
+        )
+        cb, per_batch = int(chunk.y.shape[1]), int(chunk.y.shape[2])
+        self.adapt = AdaptationController(
+            self,
+            resolve_policies(specs, self.tenants),
+            per_batch=per_batch,
+            num_features=int(chunk.X.shape[3]),
+            rows_per_chunk=self.tenant_partitions * cb * per_batch,
+            log=telemetry,
+            seed=self._seed,
+        )
 
     # -- tenant plane --------------------------------------------------------
 

@@ -111,7 +111,108 @@ def planted_prototypes_xy(
     return s.X, s.y
 
 
-_SYNTH_REGISTRY = {"rialto": rialto_like_xy, "prototypes": planted_prototypes_xy}
+def _class_protos(rng, classes: int, features: int, sep: float) -> np.ndarray:
+    return rng.normal(size=(classes, features)).astype(np.float32) * sep
+
+
+def gradual_drift_xy(
+    seed: int = 0,
+    concepts: int = 4,
+    rows_per_concept: int = 1000,
+    features: int = 12,
+    classes: int = 8,
+    transition: int = 200,
+    noise: float = 1.0,
+    class_sep: float = 1.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gradual-drift stream: per-concept class prototypes with a linear
+    mixing band at every boundary (``synth:gradual``).
+
+    Unlike ``prototypes`` (one class per concept, labels = concept id),
+    every concept here holds all ``classes`` classes interleaved — the
+    label domain is fixed at ``0..classes-1`` for the whole stream, which
+    is exactly the serving ingress contract — and a concept switch
+    *redraws the class prototypes*, so a model fitted on the old concept
+    mispredicts the new one and the detectors fire on real error drift.
+    The last ``transition`` rows before each boundary sample from the
+    NEXT concept's prototypes with linearly ramping probability (the
+    classic gradual-drift shape: the new concept bleeds in, it does not
+    snap), so detection delay and adaptation are exercised on a boundary
+    that has no single true row. Registered for wire replay like
+    ``prototypes`` — the adaptation plane's proving stream.
+    """
+    if not 0 <= transition <= rows_per_concept:
+        raise ValueError(
+            f"transition must be in [0, rows_per_concept], got {transition}"
+        )
+    rng = np.random.default_rng(seed)
+    protos = np.stack(
+        [_class_protos(rng, classes, features, class_sep) for _ in range(concepts)]
+    )  # [K, C, F]
+    n = concepts * rows_per_concept
+    y = rng.integers(0, classes, n).astype(np.int32)
+    rows = np.arange(n)
+    k = rows // rows_per_concept
+    pos = rows % rows_per_concept
+    ramp = (
+        np.clip(
+            (pos - (rows_per_concept - transition)) / transition, 0.0, 1.0
+        )
+        if transition
+        else np.zeros(n)
+    )
+    use_next = (rng.random(n) < ramp) & (k < concepts - 1)
+    eff = np.where(use_next, np.minimum(k + 1, concepts - 1), k)
+    X = protos[eff, y] + noise * rng.normal(size=(n, features)).astype(
+        np.float32
+    )
+    return X.astype(np.float32), y
+
+
+def recurring_drift_xy(
+    seed: int = 0,
+    concepts: int = 6,
+    rows_per_concept: int = 1000,
+    features: int = 12,
+    classes: int = 8,
+    period: int = 2,
+    noise: float = 1.0,
+    class_sep: float = 1.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Recurring (seasonal) drift stream: concept ``k`` reuses prototype
+    set ``k % period`` from a fixed seasonal pool (``synth:recurring``).
+
+    The same multi-class geometry as :func:`gradual_drift_xy` (fixed
+    label domain, redrawn prototypes = real error drift at every abrupt
+    boundary), but the concepts *cycle*: season A returns after season
+    B, so an adaptive model that merely chases the newest window meets a
+    distribution it has seen — and discarded — before. The stream the
+    champion/challenger plane is proven on: a demoted challenger and a
+    returning season are the cases a pure swap-on-drift policy gets
+    wrong.
+    """
+    if period < 1:
+        raise ValueError(f"period must be >= 1, got {period}")
+    rng = np.random.default_rng(seed)
+    pool = np.stack(
+        [_class_protos(rng, classes, features, class_sep) for _ in range(period)]
+    )  # [S, C, F]
+    n = concepts * rows_per_concept
+    y = rng.integers(0, classes, n).astype(np.int32)
+    k = np.arange(n) // rows_per_concept
+    eff = (k % period).astype(np.int64)
+    X = pool[eff, y] + noise * rng.normal(size=(n, features)).astype(
+        np.float32
+    )
+    return X.astype(np.float32), y
+
+
+_SYNTH_REGISTRY = {
+    "rialto": rialto_like_xy,
+    "prototypes": planted_prototypes_xy,
+    "gradual": gradual_drift_xy,
+    "recurring": recurring_drift_xy,
+}
 
 
 def parse_synth(spec: str) -> tuple[np.ndarray, np.ndarray]:

@@ -153,6 +153,43 @@ def _emit_client_spans(
     return n
 
 
+def adapt_attribution(
+    verdict_records: "list[dict]", events: "list[dict]"
+) -> dict:
+    """Attribute refit latency from a replay's artifacts: verdict
+    records carry each chunk's publication wall-clock, ``adaptation``
+    events carry the trigger chunk and their own stamp — the delta is
+    the drift→adaptation latency a client experiences. Returns the
+    summary-JSON fields (Nones when nothing adapted)."""
+    by_chunk: dict[int, float] = {}
+    for r in verdict_records:
+        by_chunk.setdefault(int(r["chunk"]), float(r["ts"]))
+    lat_ms, row_spans = [], []
+    for e in events:
+        if e.get("type") != "adaptation":
+            continue
+        t0 = by_chunk.get(int(e["trigger_chunk"]))
+        if t0 is not None:
+            lat_ms.append((float(e["ts"]) - t0) * 1000.0)
+        if e.get("rows_to_apply") is not None:
+            row_spans.append(int(e["rows_to_apply"]))
+    n = sum(1 for e in events if e.get("type") == "adaptation")
+    return {
+        "adaptations": n,
+        "adapt_promoted": sum(
+            1
+            for e in events
+            if e.get("type") == "adaptation" and e.get("promoted")
+        ),
+        "adapt_latency_ms_p50": (
+            round(float(np.percentile(lat_ms, 50)), 2) if lat_ms else None
+        ),
+        "adapt_rows_to_apply_p50": (
+            float(np.percentile(row_spans, 50)) if row_spans else None
+        ),
+    }
+
+
 class _VerdictTail:
     """Incremental verdict-sidecar reader (torn-tail tolerant: the offset
     only advances past complete lines, like ``telemetry.watch.LogTail``)."""
@@ -195,16 +232,29 @@ def _connect(host: str, port: int, timeout: float) -> socket.socket:
 
 
 def _send_rows(
-    sock: socket.socket, lines: list[str], rate: float, batch: int = 256
+    sock: socket.socket,
+    lines: list[str],
+    rate: float,
+    batch: int = 256,
+    label_lag: int = 0,
 ) -> np.ndarray:
     """Send data lines paced to ``rate`` rows/s (0 = as fast as the
-    socket takes them); returns per-row send wall-clock stamps."""
+    socket takes them); returns per-row send wall-clock stamps.
+
+    ``label_lag`` is the delayed-labels replay mode (``--delayed-labels
+    K``): a labeled row can only enter the wire once its label exists,
+    and the label of row *i* "arrives" with the generation of row
+    ``i + K`` — so row *i* ships at row ``i + K``'s pace slot, a
+    constant lag of ``K / rate`` seconds between a feature vector's
+    nominal arrival and its labeled admission. Pacing-only (needs
+    ``rate > 0``); stream order is unchanged, so verdict attribution and
+    the positional admission contract are untouched."""
     send_ts = np.empty(len(lines), np.float64)
     start = time.monotonic()
     i = 0
     while i < len(lines):
         if rate > 0:
-            due = int((time.monotonic() - start) * rate) + 1
+            due = int((time.monotonic() - start) * rate) + 1 - label_lag
             if due <= i:
                 time.sleep(min(0.002, 1.0 / rate))
                 continue
@@ -233,6 +283,7 @@ def _run_loadgen_tenants(
     interleave: int = 64,
     trace_ctx: "dict[int, tuple[str, str]] | None" = None,
     trace_log=None,
+    label_lag: int = 0,
 ) -> dict:
     """Multi-tenant replay: the stream is dealt round-robin (blocks of
     ``interleave`` rows) across T tenant slots over ONE connection, with
@@ -269,7 +320,8 @@ def _run_loadgen_tenants(
         t0 = time.monotonic()
         for t, idx in segments:
             if rate > 0:
-                while sent_so_far > (time.monotonic() - t0) * rate:
+                # label_lag: same delayed-labels pace shift as _send_rows
+                while sent_so_far + label_lag > (time.monotonic() - t0) * rate:
                     time.sleep(min(0.002, 1.0 / rate))
             payload = (
                 f"TENANT {t}\n"
@@ -392,6 +444,7 @@ def run_loadgen(
     trace_sample: float = 0.0,
     trace_seed: int = 0,
     trace_log=None,
+    label_lag: int = 0,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
@@ -401,7 +454,10 @@ def run_loadgen(
     latency attribution — see :func:`_run_loadgen_tenants`.
     ``trace_sample``/``trace_seed`` head-sample the replay (TRACE wire
     stamps, telemetry.tracing); ``trace_log`` (an ``EventLog``) receives
-    one root ``ingress`` span per sampled-and-covered row."""
+    one root ``ingress`` span per sampled-and-covered row.
+    ``label_lag`` replays with labels arriving K rows late (see
+    :func:`_send_rows`) — the realistic shape adaptation refits are
+    exercised under."""
     trace_ctx = sample_traces(len(lines), trace_sample, trace_seed)
     if tenants > 1:
         return _run_loadgen_tenants(
@@ -409,7 +465,7 @@ def run_loadgen(
             rate=rate, verdicts=verdicts, timeout=timeout, flush=flush,
             stop=stop, connect_timeout=connect_timeout,
             expect_rows=expect_rows, trace_ctx=trace_ctx,
-            trace_log=trace_log,
+            trace_log=trace_log, label_lag=label_lag,
         )
     tail = _VerdictTail(verdicts) if verdicts else None
     baseline = 0
@@ -421,7 +477,9 @@ def run_loadgen(
     sock = _connect(host, port, connect_timeout)
     try:
         t0 = time.monotonic()
-        send_ts = _send_rows(sock, _stamp_lines(lines, trace_ctx), rate)
+        send_ts = _send_rows(
+            sock, _stamp_lines(lines, trace_ctx), rate, label_lag=label_lag
+        )
         sent_span = time.monotonic() - t0
         if flush:
             sock.sendall(b"FLUSH\n")
@@ -515,6 +573,12 @@ def main(argv=None) -> None:
                     help="max seconds to wait for verdict coverage")
     ap.add_argument("--stop", action="store_true",
                     help="send STOP after the replay (drain the daemon)")
+    ap.add_argument("--delayed-labels", type=int, default=0, metavar="K",
+                    help="labels arrive K rows after features: each row "
+                    "ships at row i+K's pace slot (needs --rate), so "
+                    "adaptation refits are exercised under realistic "
+                    "label lag; refit latency is attributed in the "
+                    "summary JSON when --dir is given")
     ap.add_argument("--trace-sample", type=float, default=0.0,
                     help="head-sample the replay at this rate (0..1): "
                     "sampled rows carry TRACE wire stamps and, with "
@@ -551,6 +615,8 @@ def main(argv=None) -> None:
             config={"kind": "loadgen", "source": args.source,
                     "trace_sample": args.trace_sample},
         )
+    if args.delayed_labels and args.rate <= 0:
+        ap.error("--delayed-labels is a pacing mode and needs --rate > 0")
     t0 = time.monotonic()
     report = run_loadgen(
         args.host,
@@ -564,6 +630,7 @@ def main(argv=None) -> None:
         trace_sample=args.trace_sample,
         trace_seed=args.trace_seed,
         trace_log=trace_log,
+        label_lag=args.delayed_labels,
     )
     report.update(
         source=args.source,
@@ -571,6 +638,41 @@ def main(argv=None) -> None:
         classes=num_classes,
         dirty_rows=dirty_rows,
     )
+    if args.delayed_labels:
+        report["label_lag_rows"] = args.delayed_labels
+    if args.telemetry_dir:
+        # Refit-latency attribution (adapt subsystem): join the daemon's
+        # adaptation events against the verdict stream's publication
+        # stamps. Every run log in the directory is scanned (the
+        # loadgen's own --trace-sample client log would otherwise shadow
+        # the daemon's as the newest); best-effort — a policy-free
+        # daemon yields zero counts.
+        import glob as _glob
+
+        from ..telemetry import registry as _registry
+        from ..telemetry.events import SchemaError, read_events
+
+        events = []
+        for p in _glob.glob(os.path.join(args.telemetry_dir, "*.jsonl")):
+            base = os.path.basename(p)
+            if base == _registry.INDEX_NAME or base.endswith(
+                _registry.SIDECAR_SUFFIXES
+            ):
+                continue
+            try:
+                events.extend(
+                    e
+                    for e in read_events(p, allow_partial_tail=True)
+                    if e["type"] == "adaptation"
+                )
+            except (OSError, SchemaError, ValueError):
+                continue
+        if verdicts and os.path.exists(verdicts):
+            from .runner import read_verdicts
+
+            report.update(
+                adapt_attribution(read_verdicts(verdicts), events)
+            )
     if trace_log is not None:
         trace_log.emit(
             "run_completed",

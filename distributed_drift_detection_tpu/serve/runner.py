@@ -196,6 +196,7 @@ class ServeRunner:
         self._sampler = None  # daemon-side head sampler (trace plane)
         self._rows_traced = 0  # rows whose serving span chain was emitted
         self._forensics = None  # telemetry.forensics.ForensicsExtractor
+        self._adapt = None  # adapt.refit.AdaptationController
         self._flag_base = 0  # flag columns published == batches published
         self._published = 0  # chunks published this process
         self._ckpt_at = 0
@@ -402,6 +403,32 @@ class ServeRunner:
                 metrics=self._metrics,
             )
             self.admissions = [self.admission]
+        # Adaptation plane (adapt/ subsystem): consume drift verdicts per
+        # the per-tenant --on-drift policy. No spec (or all alert_only)
+        # builds nothing at all — the policy-free daemon is byte-identical
+        # to one that never imported the package.
+        from ..adapt.policy import resolve_policies
+
+        policies = resolve_policies(params.on_drift, self.tenants)
+        if any(p.active for p in policies):
+            from ..adapt.refit import ADAPT_STATE_SUFFIX, AdaptationController
+
+            self._adapt = AdaptationController(
+                self.det,
+                policies,
+                per_batch=cfg.per_batch,
+                num_features=params.num_features,
+                rows_per_chunk=self.batcher.rows_per_chunk,
+                log=self._log,
+                metrics=self._metrics,
+                seed=cfg.seed,
+            )
+            if params.checkpoint and resume is not None:
+                # mid-adaptation state (window buffers, probation
+                # champions) resumes next to the detector carry
+                self._adapt.restore(params.checkpoint + ADAPT_STATE_SUFFIX)
+            # warm the adaptation programs before traffic (AOT posture)
+            self._adapt.prepare(params.chunk_batches)
         if self._log is not None:
             from ..telemetry import registry as run_registry
 
@@ -480,6 +507,11 @@ class ServeRunner:
             "verdicts": self.verdicts_path,
             "checkpoint": params.checkpoint or None,
             "resumed": resume is not None,
+            "on_drift": (
+                [p.on_drift for p in policies]
+                if self._adapt is not None
+                else None
+            ),
         }
 
     def request_stop(self) -> None:
@@ -623,6 +655,9 @@ class ServeRunner:
                     else 0
                 ),
             },
+            "adaptation": (
+                self._adapt.snapshot() if self._adapt is not None else None
+            ),
         }
 
     # -- the loop ------------------------------------------------------------
@@ -663,8 +698,14 @@ class ServeRunner:
                             item.meta,
                             entry,
                             # the chunk's numpy-backed host copy, kept only
-                            # while forensics needs its context rows
-                            item.chunk if self._forensics is not None else None,
+                            # while forensics needs its context rows or the
+                            # adaptation plane its post-drift window rows
+                            (
+                                item.chunk
+                                if self._forensics is not None
+                                or self._adapt is not None
+                                else None
+                            ),
                         )
                     )
                 self._inflight_n = len(inflight)
@@ -829,6 +870,10 @@ class ServeRunner:
                 log=self._log,
                 trace_ids=trace_ids,
             )
+        if self._adapt is not None:
+            # the reaction arm: route this verdict through the per-tenant
+            # policy — forensics above explains the drift, this acts on it
+            self._adapt.on_chunk(meta, host, chunk)
         if self._log is not None:
             from ..telemetry.events import emit_flag_events
 
@@ -856,6 +901,12 @@ class ServeRunner:
                     int(r) for r in meta["t_rows_through"]
                 ],
             }
+        if self._adapt is not None:
+            from ..adapt.refit import ADAPT_STATE_SUFFIX
+
+            # adaptation state (window buffers, probation champions)
+            # rides next to the carry — the mid-adaptation resume contract
+            self._adapt.save(self.params.checkpoint + ADAPT_STATE_SUFFIX)
         save_checkpoint(
             self.params.checkpoint,
             self.det.carry,
@@ -1048,7 +1099,25 @@ def main(argv=None) -> None:
                     help="disable drift evidence bundles "
                     "(<run-log>.forensics/; on by default with a "
                     "telemetry dir)")
+    ap.add_argument("--on-drift", action="append", default=[],
+                    metavar="[T=]POLICY[,k=v...]",
+                    help="drift-reaction policy (adapt/ subsystem), "
+                    "repeatable: alert_only (default — verdicts only "
+                    "publish), retrain (refit on a post-drift window and "
+                    "hot-swap at a chunk boundary), shadow "
+                    "(champion/challenger: swap gated on measured "
+                    "shadow-slice error). Prefix T= targets one tenant; "
+                    "knobs: window_rows, cooldown_rows, margin, epsilon")
     args = ap.parse_args(argv)
+
+    # Validate --on-drift at argv time (jax-free policy grammar): a bad
+    # spec must fail here, not after the backend initialised.
+    from ..adapt.policy import resolve_policies as _resolve_policies
+
+    try:
+        _resolve_policies(args.on_drift, args.tenants)
+    except ValueError as e:
+        ap.error(str(e))
 
     # CLI-driven fault arming (DDD_FAULTS, the grid harness's pattern):
     # inert unless the env var is set. The ops-smoke CI job wedges the
@@ -1090,6 +1159,7 @@ def main(argv=None) -> None:
         flightrec_events=args.flightrec_events,
         trace_sample=args.trace_sample,
         forensics=not args.no_forensics,
+        on_drift=tuple(args.on_drift),
     )
     runner = ServeRunner(cfg, params, max_chunks=args.max_chunks)
     banner = runner.start()

@@ -112,6 +112,22 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # host-side from the already-collected flag tables + the chunk's
     # host copy — never from jitted code.
     "drift_forensics": ("chunk", "partition", "global_pos", "bundle"),
+    # A drift adaptation decision landed (adapt subsystem): tenant
+    # ``tenant``'s ``policy`` (retrain|shadow) consumed the drift verdict
+    # of ``trigger_chunk``, refitted on ``rows_refit`` post-drift window
+    # rows, and measured champion-vs-challenger error on that window
+    # (``err_before``/``err_after`` — None when the window held no valid
+    # rows). ``promoted`` = the challenger now serves (always True for
+    # retrain; gated on measured error for shadow; False with the
+    # ``demoted`` extra when a probation window reverted a promotion).
+    # Extras: ``applied_chunk``, ``rows_to_apply`` (rows from verdict to
+    # application), ``pre_drift_err``, ``window_rows``. Emitted host-side
+    # at verdict publication — never from jitted code, serve/chunked
+    # paths only (api.run's Final Time purity holds by construction).
+    "adaptation": (
+        "tenant", "trigger_chunk", "policy", "rows_refit",
+        "err_before", "err_after", "promoted",
+    ),
     # one per run log, last event: totals over the reference's Final Time
     "run_completed": ("rows", "seconds", "detections"),
 }
@@ -129,6 +145,9 @@ _NULLABLE = frozenset(
         ("cost_analysis", "flops"),
         ("cost_analysis", "bytes_accessed"),
         ("span", "parent_id"),  # root spans have no parent
+        # an empty/fully-masked refit window has no error to measure
+        ("adaptation", "err_before"),
+        ("adaptation", "err_after"),
     }
 )
 

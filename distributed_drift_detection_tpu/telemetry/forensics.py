@@ -333,6 +333,65 @@ def find_bundles(path: str) -> "list[str]":
     return []
 
 
+def adaptation_index(bundle_path: str) -> "dict[tuple, list[dict]]":
+    """The ``adaptation`` events matching a bundle's run, indexed by
+    ``(tenant, trigger_chunk)`` — the join key between a drift's
+    *cause* (the forensics bundle) and its *reaction* (the adapt
+    subsystem's event). The run log is the bundle directory's sibling
+    (``X.forensics/`` ↔ ``X.jsonl``); a missing or partial log (a live
+    daemon) yields what is readable, never an error — explain must
+    render wherever the artifacts land."""
+    d = os.path.dirname(os.path.abspath(bundle_path))
+    if not d.endswith(FORENSICS_SUFFIX):
+        return {}
+    log = d[: -len(FORENSICS_SUFFIX)] + ".jsonl"
+    if not os.path.isfile(log):
+        return {}
+    from .events import SchemaError, read_events
+
+    try:
+        events = read_events(log, allow_partial_tail=True)
+    except SchemaError:
+        return {}
+    out: dict = {}
+    for e in events:
+        if e.get("type") != "adaptation":
+            continue
+        key = (int(e.get("tenant", 0)), int(e["trigger_chunk"]))
+        out.setdefault(key, []).append(e)
+    return out
+
+
+def render_adaptation(events: "list[dict] | None") -> "list[str]":
+    """The reaction lines rendered under a bundle: one per matching
+    ``adaptation`` event, or the explicit "no reaction" line — one
+    command shows cause AND reaction."""
+    if not events:
+        return ["  reaction       none recorded (on_drift=alert_only?)"]
+    out = []
+    for e in events:
+        verdict = (
+            "demoted"
+            if e.get("demoted")
+            else ("promoted" if e.get("promoted") else "held (champion kept)")
+        )
+        errs = (
+            f"err {_fmt(e.get('err_before'), 3)} -> "
+            f"{_fmt(e.get('err_after'), 3)}"
+        )
+        out.append(
+            f"  reaction       policy={e['policy']}  {verdict}  {errs}  "
+            f"refit on {e['rows_refit']} row(s)"
+            + (
+                f"  applied +{e['rows_to_apply']} rows "
+                f"(chunk {e.get('applied_chunk')})"
+                if e.get("rows_to_apply") is not None
+                else ""
+            )
+        )
+    return out
+
+
 def read_bundle(path: str) -> dict:
     with open(path) as fh:
         bundle = json.load(fh)
@@ -349,8 +408,10 @@ def _fmt(v, nd=6) -> str:
     return str(v)
 
 
-def render_bundle(bundle: dict) -> str:
-    """Human-readable rendering of one evidence bundle."""
+def render_bundle(bundle: dict, adaptation: "list[dict] | None" = None) -> str:
+    """Human-readable rendering of one evidence bundle; ``adaptation``
+    (the matching ``adaptation`` events, see :func:`adaptation_index`)
+    appends the reaction lines so cause and reaction read together."""
     out = []
     tenant = (
         f" tenant {bundle['tenant']} (local p{bundle['tenant_partition']})"
@@ -414,6 +475,8 @@ def render_bundle(bundle: dict) -> str:
             "  traces         " + " ".join(bundle["trace_ids"][:4])
             + (" ..." if len(bundle["trace_ids"]) > 4 else "")
         )
+    if adaptation is not None:
+        out.extend(render_adaptation(adaptation))
     return "\n".join(out)
 
 
@@ -437,10 +500,16 @@ def main(argv=None) -> None:
     if not bundles:
         raise SystemExit(f"explain: no forensics bundles under {args.path}")
     shown = bundles[: args.limit]
+    adapt_cache: dict = {}  # bundle dir -> adaptation index (one log read)
     for i, p in enumerate(shown):
         if i:
             print()
-        print(render_bundle(read_bundle(p)))
+        bundle = read_bundle(p)
+        d = os.path.dirname(os.path.abspath(p))
+        if d not in adapt_cache:
+            adapt_cache[d] = adaptation_index(p)
+        key = (int(bundle.get("tenant") or 0), int(bundle["chunk"]))
+        print(render_bundle(bundle, adaptation=adapt_cache[d].get(key, [])))
     hidden = len(bundles) - len(shown)
     print(
         f"\n{len(bundles)} bundle(s)"

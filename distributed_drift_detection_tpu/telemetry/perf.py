@@ -121,6 +121,13 @@ CELLS = (
     ("serve_p99_ms", _DOWN, True, "ms"),
     ("serve_registry_p50_ms", _DOWN, False, "ms"),
     ("serve_registry_p99_ms", _DOWN, False, "ms"),
+    # Adaptation recovery (bench.py --serve adapt rider, r12+): rows from
+    # a drift verdict until post-drift chunk error returns within the
+    # policy's epsilon of the pre-drift level, on the planted
+    # recurring-drift stream. Informational — the span moves with the
+    # stream geometry and the chunk span; the adapt-smoke CI job and
+    # tests/test_adapt.py own correctness.
+    ("serve_adapt_recovery_rows", _DOWN, False, "rows"),
     ("xla_flops", _DOWN, False, "flops"),
     ("xla_bytes_accessed", _DOWN, False, "B"),
     ("xla_temp_bytes", _DOWN, False, "B"),
@@ -276,6 +283,7 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "serve_p99_ms",
         "serve_registry_p50_ms",
         "serve_registry_p99_ms",
+        "serve_adapt_recovery_rows",
         "mean_delay_batches",
         "detections",
     ):

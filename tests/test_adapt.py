@@ -1,0 +1,529 @@
+"""adapt/ subsystem: drift-triggered live retraining with
+champion/challenger serving (ISSUE 12).
+
+Headline acceptance: on a planted-drift stream the adapting daemon's
+post-drift error returns to pre-drift levels while ``on_drift=alert_only``
+reproduces the policy-free daemon bit-exactly (flags AND verdict sidecar,
+modulo wall-clock stamps); per-tenant adaptation triggers zero recompiles
+of the serving chunk program (AOT executables keep serving, the jit
+dispatch cache stays empty); champion/challenger promotion + demotion and
+drain → checkpoint → bit-identical resume of mid-adaptation state are
+covered below.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu import RunConfig
+from distributed_drift_detection_tpu.adapt import (
+    AdaptPolicy,
+    parse_policy,
+    resolve_policies,
+    should_demote,
+    should_promote,
+)
+from distributed_drift_detection_tpu.adapt.policy import (
+    resolve_cooldown_rows,
+    resolve_window_rows,
+)
+from distributed_drift_detection_tpu.config import ServeParams
+from distributed_drift_detection_tpu.io.synth import recurring_drift_xy
+from distributed_drift_detection_tpu.serve import ServeRunner, read_verdicts
+from distributed_drift_detection_tpu.serve.loadgen import format_lines
+from distributed_drift_detection_tpu.telemetry.events import read_events
+
+
+# -- policy grammar (jax-free) ----------------------------------------------
+
+
+def test_parse_policy_grammar():
+    assert parse_policy("retrain") == (None, AdaptPolicy(on_drift="retrain"))
+    t, p = parse_policy("2=shadow,window_rows=400,margin=0.05")
+    assert t == 2 and p.on_drift == "shadow"
+    assert p.window_rows == 400 and p.margin == pytest.approx(0.05)
+    with pytest.raises(ValueError, match="unknown on_drift policy"):
+        parse_policy("promote")
+    with pytest.raises(ValueError, match="knob"):
+        parse_policy("retrain,bogus=1")
+    with pytest.raises(ValueError, match="tenant prefix"):
+        parse_policy("x=retrain")
+
+
+def test_resolve_policies_overrides_and_defaults():
+    ps = resolve_policies((), 3)
+    assert all(p.on_drift == "alert_only" and not p.active for p in ps)
+    ps = resolve_policies(["retrain", "1=shadow"], 3)
+    assert [p.on_drift for p in ps] == ["retrain", "shadow", "retrain"]
+    with pytest.raises(ValueError, match="targets tenant"):
+        resolve_policies(["5=retrain"], 2)
+    p = AdaptPolicy(on_drift="retrain")
+    assert resolve_window_rows(p, 100) == 100
+    assert resolve_cooldown_rows(p, 100) == 200
+    assert resolve_window_rows(p._replace(window_rows=64), 100) == 64
+
+
+def test_promotion_demotion_gates():
+    assert should_promote(0.4, 0.1, margin=0.02)
+    assert not should_promote(0.1, 0.09, margin=0.02)  # inside the margin
+    assert not should_promote(None, 0.0, margin=0.02)  # no evidence
+    assert should_demote(0.1, 0.4, margin=0.02)
+    assert not should_demote(0.4, 0.1, margin=0.02)
+    assert not should_demote(None, None, margin=0.02)
+
+
+# -- in-process serving harness ---------------------------------------------
+
+
+def _cfg(tmp, seed=0, tenants=1, **kw):
+    return RunConfig(
+        partitions=2,
+        per_batch=25,
+        model="centroid",
+        results_csv="",
+        seed=seed,
+        window=1,
+        tenants=tenants,
+        telemetry_dir=str(tmp),
+        data_policy="quarantine",
+        **kw,
+    )
+
+
+def _params(features, classes, **kw):
+    kw.setdefault("port", None)
+    kw.setdefault("chunk_batches", 2)
+    kw.setdefault("linger_s", 0.05)
+    kw.setdefault("slo", ("none",))
+    kw.setdefault("forensics", False)
+    return ServeParams(num_features=features, num_classes=classes, **kw)
+
+
+def _drive(runner, lines, block=100):
+    for i in range(0, len(lines), block):
+        runner.admission.admit_lines(lines[i : i + block])
+    runner.batcher.flush()
+    runner.request_stop()
+    assert runner.serve_forever() == 0
+    return runner
+
+
+def _adapt_events(tmp):
+    out = []
+    for name in sorted(os.listdir(tmp)):
+        if (
+            not name.endswith(".jsonl")
+            or ".verdicts" in name
+            or ".quarantine" in name
+            or name == "index.jsonl"
+        ):
+            continue
+        out += [
+            e
+            for e in read_events(os.path.join(tmp, name))
+            if e["type"] == "adaptation"
+        ]
+    return out
+
+
+STREAM = recurring_drift_xy(seed=1, concepts=4, rows_per_concept=600)
+
+
+# -- retrain: the paper's loop closed live ----------------------------------
+
+
+def test_retrain_recovers_post_drift_error(tmp_path):
+    X, y = STREAM
+    r = ServeRunner(
+        _cfg(tmp_path),
+        _params(X.shape[1], 8, on_drift=("retrain",)),
+        keep_flags=True,
+    )
+    r.start()
+    _drive(r, format_lines(X, y))
+    events = _adapt_events(tmp_path)
+    assert events, "planted drift must trigger adaptations"
+    assert all(e["policy"] == "retrain" and e["promoted"] for e in events)
+    # the window refit measurably beats the stale/kernel-refit params on
+    # the post-drift window (the headline error drop)
+    drops = [
+        e for e in events if e["err_before"] is not None and e["err_after"] is not None
+    ]
+    assert drops
+    assert any(e["err_after"] < e["err_before"] - 0.1 for e in drops)
+    # ... and the recovery watch saw post-drift chunk error return within
+    # epsilon of the pre-drift running level
+    assert r._adapt.recovery_rows() is not None
+    # the adaptation left its statusz evidence (a final trigger may
+    # legitimately still be accumulating when the stream ends)
+    snap = r._adapt.snapshot()
+    assert snap["adaptations"] == len(events)
+
+
+def test_adaptation_events_schema_and_span(tmp_path):
+    X, y = STREAM
+    r = ServeRunner(
+        _cfg(tmp_path), _params(X.shape[1], 8, on_drift=("retrain",))
+    )
+    r.start()
+    _drive(r, format_lines(X, y))
+    logs = [
+        n
+        for n in os.listdir(tmp_path)
+        if n.endswith(".jsonl")
+        and ".verdicts" not in n
+        and ".quarantine" not in n
+        and n != "index.jsonl"
+    ]
+    events = read_events(os.path.join(tmp_path, logs[0]))  # schema-valid
+    adapts = [e for e in events if e["type"] == "adaptation"]
+    spans = [
+        e for e in events if e["type"] == "span" and e["name"] == "adaptation"
+    ]
+    assert adapts and len(spans) == len(adapts)
+    for e in adapts:
+        assert e["rows_to_apply"] >= 0 and e["rows_refit"] > 0
+        assert e["applied_chunk"] >= e["trigger_chunk"]
+
+
+# -- alert_only: bit-exact with the policy-free daemon -----------------------
+
+
+def test_alert_only_matches_policy_free_daemon(tmp_path):
+    X, y = STREAM
+    runs = {}
+    for tag, on_drift in (("free", ()), ("alert", ("alert_only",))):
+        d = tmp_path / tag
+        d.mkdir()
+        r = ServeRunner(
+            _cfg(d), _params(X.shape[1], 8, on_drift=on_drift),
+            keep_flags=True,
+        )
+        r.start()
+        _drive(r, format_lines(X, y))
+        verdicts = read_verdicts(r.verdicts_path)
+        runs[tag] = (r.flags(), verdicts, r._adapt)
+    flags_free, v_free, adapt_free = runs["free"]
+    flags_alert, v_alert, adapt_alert = runs["alert"]
+    assert adapt_free is None and adapt_alert is None  # nothing built
+    for name in flags_free._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(flags_free, name)),
+            np.asarray(getattr(flags_alert, name)),
+            err_msg=name,
+        )
+    # verdict sidecars byte-identical modulo the wall-clock ts field
+    strip = lambda recs: [
+        {k: v for k, v in r.items() if k != "ts"} for r in recs
+    ]
+    assert strip(v_free) == strip(v_alert)
+    # and the adapting run's flags genuinely differ (the reaction is real)
+    e = tmp_path / "adapt"
+    e.mkdir()
+    r = ServeRunner(
+        _cfg(e), _params(X.shape[1], 8, on_drift=("retrain",)),
+        keep_flags=True,
+    )
+    r.start()
+    _drive(r, format_lines(X, y))
+    assert not np.array_equal(
+        np.asarray(r.flags().change_global),
+        np.asarray(flags_free.change_global),
+    )
+
+
+# -- shadow: champion/challenger --------------------------------------------
+
+
+def test_shadow_promotes_on_measured_error(tmp_path):
+    X, y = STREAM
+    r = ServeRunner(
+        _cfg(tmp_path), _params(X.shape[1], 8, on_drift=("shadow",))
+    )
+    r.start()
+    _drive(r, format_lines(X, y))
+    events = _adapt_events(tmp_path)
+    promoted = [e for e in events if e["promoted"]]
+    assert promoted, "the stale champion must lose on a real drift"
+    for e in promoted:
+        assert e["err_after"] < e["err_before"]  # the measured gate
+
+
+def test_shadow_demotes_regressed_challenger(tmp_path):
+    # Unit-drive the probation path: promote a challenger, then hand the
+    # probation window rows the CHAMPION still wins on — the controller
+    # must restore the champion's params (a params-only swap).
+    import jax
+
+    from distributed_drift_detection_tpu.adapt.refit import (
+        AdaptationController,
+    )
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.stream import stripe_chunk
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    X, y = recurring_drift_xy(
+        seed=3, concepts=2, rows_per_concept=400, features=6, classes=4
+    )
+    model = build_model("centroid", ModelSpec(6, 4), RunConfig())
+    P, B, CB = 2, 20, 2
+    span = P * B * CB
+    det = ChunkedDetector(model, partitions=P, seed=0, window=1)
+    policies = resolve_policies(
+        ["shadow,window_rows=80,margin=0.0,cooldown_rows=80"], 1
+    )
+    ctl = AdaptationController(
+        det, policies, per_batch=B, num_features=6, rows_per_chunk=span
+    )
+
+    chunks = [
+        stripe_chunk(X[s : s + span], y[s : s + span], s, P, B, CB)
+        for s in range(0, 800, span)
+    ]
+    outcomes = []
+    orig_count = ctl._count
+    ctl._count = lambda t, st, outcome: (
+        outcomes.append(outcome), orig_count(t, st, outcome)
+    )
+    rows = 0
+    champ_host = None
+    for i, c in enumerate(chunks):
+        flags = jax.tree.map(np.asarray, det.feed(det.place(c)))
+        rows += span
+        st = ctl.states[0]
+        if st.phase == "probation":
+            # the retained champion of THIS probation cycle; the
+            # probation window below replays the PRE-drift concept, so
+            # the champion wins and the controller must demote
+            champ_host = st.champion
+        ctl.on_chunk(
+            {"chunk": i, "rows_through": rows}, flags,
+            chunks[0] if st.phase == "probation" else c,
+        )
+        if "demoted" in outcomes:
+            break
+    assert "promoted" in outcomes, "no promotion happened"
+    assert "demoted" in outcomes, "regressed challenger was not demoted"
+    restored = jax.device_get(ctl._tenant_params(0))
+    for a, b in zip(jax.tree.leaves(champ_host), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- zero recompiles at T=64 -------------------------------------------------
+
+
+def test_tenant_adaptation_zero_recompiles(tmp_path):
+    from distributed_drift_detection_tpu.config import replace
+
+    T = 64
+    X, y = recurring_drift_xy(
+        seed=1, concepts=2, rows_per_concept=100, features=6, classes=4
+    )
+    cfg = replace(_cfg(tmp_path, tenants=T), partitions=1, per_batch=10)
+    r = ServeRunner(cfg, _params(6, 4, on_drift=("retrain",)))
+    r.start()
+    lines = format_lines(X, y)
+    span = 20  # one tenant chunk span (P*B*CB = 1*10*2)
+    # balanced dealing: every tenant receives the SAME planted-drift
+    # stream, one span per round, so full-grid seals stay aligned
+    for s in range(0, len(lines), span):
+        for t in range(T):
+            r.admissions[t].admit_lines(lines[s : s + span])
+    r.batcher.flush()
+    r.request_stop()
+    assert r.serve_forever() == 0
+    # the planted boundary adapts across the tenant plane (tenant seeds
+    # differ, so a few detectors may fire a chunk early/late)
+    assert r._adapt.snapshot()["adaptations"] >= T // 2
+    # the serving chunk program NEVER recompiled: the AOT executables
+    # served every feed (no sticky fallback) and the jit dispatch cache
+    # stayed empty — the PR-6 counters, flat through T=64 adaptation
+    assert r.det._exec_fallen is False
+    assert r.det._run_chunk._cache_size() == 0
+    assert len(r.det._exec) > 0
+    # ... and every adaptation program compiled exactly once
+    for name in ("_fit_window", "_score_pair", "_chunk_err", "_swap_full"):
+        assert getattr(r._adapt, name)._cache_size() <= 1, name
+
+
+# -- drain -> checkpoint -> bit-identical resume -----------------------------
+
+
+def test_mid_adaptation_drain_resume_bit_identical(tmp_path):
+    X, y = STREAM
+    lines = format_lines(X, y)
+    policy = "retrain,window_rows=200"  # spans 2 chunks: drains land mid-window
+
+    def run_segments(d, segments):
+        d.mkdir()
+        ckpt = str(d / "serve.ckpt")
+        flags = []
+        for seg in segments:
+            r = ServeRunner(
+                _cfg(d),
+                _params(
+                    X.shape[1], 8, on_drift=(policy,), checkpoint=ckpt
+                ),
+                keep_flags=True,
+            )
+            r.start()
+            _drive(r, seg)
+            if r.flags() is not None:
+                flags.append(r.flags())
+        cg = np.concatenate(
+            [np.asarray(f.change_global) for f in flags], axis=1
+        )
+        return cg, _adapt_events(d)
+
+    cut = 700  # chunk-span aligned (7 x 100), mid-accumulation
+    cg_split, ev_split = run_segments(
+        tmp_path / "split", [lines[:cut], lines[cut:]]
+    )
+    cg_full, ev_full = run_segments(tmp_path / "full", [lines])
+    assert os.path.exists(tmp_path / "split" / "serve.ckpt.adapt")
+    np.testing.assert_array_equal(cg_split, cg_full)
+    key = lambda e: {
+        k: e[k]
+        for k in ("tenant", "trigger_chunk", "policy", "rows_refit", "promoted")
+    }
+    assert [key(e) for e in ev_split] == [key(e) for e in ev_full]
+
+
+# -- the chunked engine shares the code path ---------------------------------
+
+
+def test_chunked_on_drift_hook():
+    import jax
+
+    from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+    from distributed_drift_detection_tpu.io.stream import stripe_chunk
+    from distributed_drift_detection_tpu.models import ModelSpec, build_model
+
+    X, y = STREAM
+    model = build_model("centroid", ModelSpec(X.shape[1], 8), RunConfig())
+    P, B, CB = 2, 25, 2
+    span = P * B * CB
+
+    def chunks():
+        for s in range(0, len(X), span):
+            yield stripe_chunk(X[s : s + span], y[s : s + span], s, P, B, CB)
+
+    det = ChunkedDetector(
+        model, partitions=P, seed=0, window=1, on_drift="retrain"
+    )
+    flags = det.run(chunks())
+    assert det.adapt is not None and det.adapt.snapshot()["adaptations"] > 0
+    assert det.adapt.recovery_rows() is not None
+
+    # alert_only through the hook == no hook at all, bit-for-bit
+    det0 = ChunkedDetector(model, partitions=P, seed=0, window=1)
+    f0 = det0.run(chunks())
+    det1 = ChunkedDetector(
+        model, partitions=P, seed=0, window=1, on_drift="alert_only"
+    )
+    f1 = det1.run(chunks())
+    for name in f0._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f0, name)),
+            np.asarray(getattr(f1, name)),
+            err_msg=name,
+        )
+    # ... while the adapting drain genuinely reacted
+    assert not np.array_equal(
+        np.asarray(flags.change_global), np.asarray(f0.change_global)
+    )
+    del jax
+
+
+# -- explain: cause and reaction in one view ---------------------------------
+
+
+def test_explain_renders_adaptation_next_to_forensics(tmp_path, capsys):
+    from distributed_drift_detection_tpu.telemetry.forensics import (
+        main as explain_main,
+    )
+
+    X, y = STREAM
+    r = ServeRunner(
+        _cfg(tmp_path),
+        _params(X.shape[1], 8, on_drift=("retrain",), forensics=True),
+    )
+    r.start()
+    _drive(r, format_lines(X, y))
+    explain_main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "drift @ row" in out
+    assert "reaction" in out
+    assert "policy=retrain" in out and "promoted" in out
+
+
+# -- loadgen: delayed labels + refit attribution -----------------------------
+
+
+def test_adapt_attribution_joins_verdicts_and_events():
+    from distributed_drift_detection_tpu.serve.loadgen import (
+        adapt_attribution,
+    )
+
+    verdicts = [
+        {"chunk": 3, "ts": 100.0},
+        {"chunk": 4, "ts": 101.0},
+    ]
+    events = [
+        {
+            "type": "adaptation",
+            "trigger_chunk": 3,
+            "ts": 100.5,
+            "promoted": True,
+            "rows_to_apply": 120,
+        },
+        {"type": "span"},
+    ]
+    rep = adapt_attribution(verdicts, events)
+    assert rep["adaptations"] == 1 and rep["adapt_promoted"] == 1
+    assert rep["adapt_latency_ms_p50"] == pytest.approx(500.0)
+    assert rep["adapt_rows_to_apply_p50"] == 120
+    empty = adapt_attribution([], [])
+    assert empty["adaptations"] == 0
+    assert empty["adapt_latency_ms_p50"] is None
+
+
+def test_loadgen_delayed_labels_paces_rows():
+    import time as _time
+
+    from distributed_drift_detection_tpu.serve.loadgen import _send_rows
+
+    class _Sock:
+        def __init__(self):
+            self.sent = []
+
+        def sendall(self, data):
+            self.sent.append((_time.monotonic(), data))
+
+    lines = [f"{i},0" for i in range(20)]
+    rate = 400.0
+    sock_lag = _Sock()
+    t0 = _time.monotonic()
+    _send_rows(sock_lag, lines, rate, batch=4, label_lag=40)
+    lag_first = sock_lag.sent[0][0] - t0
+    # row 0 ships at row 40's pace slot: >= 40/rate seconds in
+    assert lag_first >= 40 / rate * 0.8
+    sock_now = _Sock()
+    t0 = _time.monotonic()
+    _send_rows(sock_now, lines, rate, batch=4)
+    assert sock_now.sent[0][0] - t0 < 40 / rate * 0.8
+
+
+def test_serve_params_on_drift_cli_roundtrip(tmp_path):
+    # the serve CLI validates --on-drift specs jax-free at argv time
+    from distributed_drift_detection_tpu.serve.runner import main as serve_main
+
+    with pytest.raises(SystemExit) as exc:
+        serve_main(
+            [
+                "--features", "5", "--classes", "3",
+                "--on-drift", "nonsense",
+            ]
+        )
+    assert exc.value.code == 2  # argparse error, before any jax work

@@ -1,6 +1,7 @@
 """Synthetic generators: determinism, chunk-exactness, drift detectability."""
 
 import numpy as np
+import pytest
 
 from distributed_drift_detection_tpu.io import (
     hyperplane_chunk,
@@ -91,3 +92,84 @@ def test_synth_scheme_end_to_end():
     # noisy-but-separable so nearly all should fire.
     per_part = (res.flags.change_global >= 0).sum(axis=1)
     assert (per_part >= 7).all()
+
+
+# --------------------------------------------------------------------------
+# gradual / recurring drift generators (adapt subsystem's proving streams)
+# --------------------------------------------------------------------------
+
+
+def test_gradual_drift_geometry_and_determinism():
+    from distributed_drift_detection_tpu.io.synth import gradual_drift_xy
+
+    X, y = gradual_drift_xy(
+        seed=2, concepts=3, rows_per_concept=300, features=7, classes=5,
+        transition=60,
+    )
+    assert X.shape == (900, 7) and X.dtype == np.float32
+    # fixed label domain across every concept — the serving contract
+    assert set(np.unique(y)) <= set(range(5))
+    X2, y2 = gradual_drift_xy(
+        seed=2, concepts=3, rows_per_concept=300, features=7, classes=5,
+        transition=60,
+    )
+    np.testing.assert_array_equal(X, X2)
+    np.testing.assert_array_equal(y, y2)
+    with pytest.raises(ValueError, match="transition"):
+        gradual_drift_xy(rows_per_concept=100, transition=200)
+
+
+def test_gradual_drift_transition_band_mixes_concepts():
+    from distributed_drift_detection_tpu.io.synth import gradual_drift_xy
+
+    # With zero noise every row sits exactly on a prototype, so the
+    # transition band is visible as next-concept prototypes appearing
+    # BEFORE the boundary — and nowhere earlier than the band.
+    X, y = gradual_drift_xy(
+        seed=0, concepts=2, rows_per_concept=400, features=4, classes=3,
+        transition=100, noise=0.0,
+    )
+    X2, _ = gradual_drift_xy(
+        seed=0, concepts=2, rows_per_concept=400, features=4, classes=3,
+        transition=0, noise=0.0,
+    )
+    pre_band = slice(0, 300)  # strictly before the band
+    band = slice(300, 400)
+    np.testing.assert_array_equal(X[pre_band], X2[pre_band])
+    assert (X[band] != X2[band]).any(), "band must sample the next concept"
+
+
+def test_recurring_drift_seasons_repeat():
+    from distributed_drift_detection_tpu.io.synth import recurring_drift_xy
+
+    X, y = recurring_drift_xy(
+        seed=4, concepts=4, rows_per_concept=200, features=5, classes=4,
+        period=2, noise=0.0,
+    )
+    assert X.shape == (800, 5) and set(np.unique(y)) <= set(range(4))
+    # season A (concept 0) returns as concept 2: same class → same
+    # prototype, so zero-noise rows of equal class match exactly
+    a0, y0 = X[:200], y[:200]
+    a2, y2 = X[400:600], y[400:600]
+    c = int(y0[0])
+    row_a = a0[y0 == c][0]
+    row_b = a2[y2 == c][0]
+    np.testing.assert_array_equal(row_a, row_b)
+    # while season B differs
+    b1, yb = X[200:400], y[200:400]
+    assert (b1[yb == c][0] != row_a).any()
+    with pytest.raises(ValueError, match="period"):
+        recurring_drift_xy(period=0)
+
+
+def test_gradual_recurring_registered_for_wire_replay():
+    from distributed_drift_detection_tpu.io.synth import parse_synth
+
+    X, y = parse_synth(
+        "gradual,seed=1,concepts=2,rows_per_concept=100,transition=20"
+    )
+    assert X.shape[0] == 200
+    X, y = parse_synth(
+        "recurring,seed=1,concepts=2,rows_per_concept=100,period=2"
+    )
+    assert X.shape[0] == 200

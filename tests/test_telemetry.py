@@ -59,6 +59,10 @@ EXAMPLE_EVENTS = {
         chunk=2, partition=3, global_pos=1234,
         bundle="run.forensics/drift-c2-p3-r1234.json",
     ),
+    "adaptation": dict(
+        tenant=0, trigger_chunk=4, policy="retrain", rows_refit=400,
+        err_before=0.46, err_after=0.05, promoted=True,
+    ),
     "run_completed": dict(rows=2_048_000, seconds=0.16, detections=600),
 }
 
