@@ -106,6 +106,20 @@ _CLI = {"compile_cache_dir": "", "collect": "", "ingest_workers": 0}
 from distributed_drift_detection_tpu.__main__ import _pop_flag  # noqa: E402
 
 
+def _emit(artifact: dict) -> None:
+    """Print one bench artifact under the summary-line contract
+    (``telemetry.perf.summary_lines``): the FINAL stdout line always
+    parses and always carries every gated cell. When the full artifact
+    outgrows the round driver's ~2 KB tail window (BENCH_r05.json
+    recorded ``parsed: null`` from exactly that), the full line prints
+    first and a trimmed, budget-fitting gate line prints last — the perf
+    CLI re-merges the pair."""
+    from distributed_drift_detection_tpu.telemetry.perf import summary_lines
+
+    for line in summary_lines(artifact):
+        print(line)
+
+
 def _enable_compile_cache(jax) -> None:
     # The remote TPU compile service can be slow; cache executables across
     # bench invocations (shapes are stable). utils.compile_cache is the
@@ -474,16 +488,14 @@ def tenants_bench(counts, rows_per_class: int) -> None:
 
     _enable_compile_cache(jax)
     stats = _tenant_stats(tuple(counts), rows_per_class)
-    print(
-        json.dumps(
-            {
-                "metric": "tenant_agg_rows_per_sec",
-                "unit": "rows/s",
-                "tenant_counts": list(counts),
-                **stats,
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    _emit(
+        {
+            "metric": "tenant_agg_rows_per_sec",
+            "unit": "rows/s",
+            "tenant_counts": list(counts),
+            **stats,
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -681,15 +693,13 @@ def chunked() -> None:
 
     _enable_compile_cache(jax)
     stats = _chunked_stats()
-    print(
-        json.dumps(
-            {
-                "metric": "chunked_rows_per_sec_chip",
-                "unit": "rows/s",
-                **stats,
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    _emit(
+        {
+            "metric": "chunked_rows_per_sec_chip",
+            "unit": "rows/s",
+            **stats,
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -699,15 +709,13 @@ def soak(total_rows: int) -> None:
 
     _enable_compile_cache(jax)
     stats = _soak_stats(total_rows)
-    print(
-        json.dumps(
-            {
-                "metric": "soak_rows_per_sec_chip",
-                "unit": "rows/s",
-                **stats,
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    _emit(
+        {
+            "metric": "soak_rows_per_sec_chip",
+            "unit": "rows/s",
+            **stats,
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -1119,20 +1127,140 @@ def _adapt_stats(rows: int = 4800) -> dict:
     }
 
 
+def _ingest_stats(
+    rows: int = 4_000_000, features: int = 27, frame_rows: int = 16384
+) -> dict:
+    """Warmed admission-only ingest bench: one small replay first (numpy
+    dispatch, thread/socket setup, allocator state all go hot), then the
+    measured replay — the reported cell describes steady-state ingress,
+    not process cold-start. See :func:`_ingest_once`."""
+    _ingest_once(rows=max(rows // 16, frame_rows * 8), features=features,
+                 frame_rows=frame_rows)
+    return _ingest_once(rows=rows, features=features, frame_rows=frame_rows)
+
+
+def _ingest_once(
+    rows: int = 4_000_000, features: int = 27, frame_rows: int = 16384
+) -> dict:
+    """``--serve`` rider: the **admission-only** ingest bench (ISSUE 13
+    acceptance: ≥10M rows/s on loopback). v2 binary frames stream over a
+    real loopback socket through the event-loop ingress, the vectorized
+    frame admission and the pooled-striper microbatch seals — everything
+    the serve path does to a row *except* the device feed — and the cell
+    is rows admitted-and-sealed per wall-clock second. jax-free by
+    construction (the admission plane is numpy + stdlib), so the cell
+    isolates the host ingress from kernel/tunnel noise.
+
+    The payload is one clean pre-encoded frame replayed N times (the
+    admission fast path cannot tell — every frame is decoded, bounds-
+    checked, finiteness/domain-scanned and striped individually), so the
+    client side is a pure ``sendall`` loop and the measured ceiling is
+    the daemon's, not the generator's.
+    """
+    import socket
+    import threading
+
+    from distributed_drift_detection_tpu.serve import wire
+    from distributed_drift_detection_tpu.serve.admission import (
+        AdmissionController,
+        MicroBatcher,
+    )
+    from distributed_drift_detection_tpu.serve.ingress import IngressServer
+
+    frames = max(rows // frame_rows, 1)
+    rows = frames * frame_rows
+    # Grid span == frame_rows: every frame seals exactly one chunk, the
+    # steady-state shape of a saturated v2 ingress.
+    partitions, per_batch = 8, 128
+    chunk_batches = max(frame_rows // (partitions * per_batch), 1)
+    batcher = MicroBatcher(
+        partitions, per_batch, chunk_batches,
+        shuffle_seed=None, linger_s=60.0, max_queue=64,
+    )
+    adm = AdmissionController(
+        batcher, features, 10, policy="quarantine"
+    )
+    srv = IngressServer("127.0.0.1", 0, [adm], batcher, on_stop=lambda: None)
+    srv.start()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((frame_rows, features), dtype=np.float32)
+    y = (rng.integers(0, 10, frame_rows)).astype(np.int32)
+    frame = wire.encode_frame(X, y)
+    slab = frame * max(1, min(frames, (1 << 22) // len(frame)))
+
+    drained = {"rows": 0}
+
+    def _consume() -> None:
+        while drained["rows"] < rows:
+            item = batcher.get(5.0)
+            if item is None:
+                return  # stalled producer — the timeout marker will show
+            drained["rows"] += item.meta["rows"]
+
+    consumer = threading.Thread(target=_consume, daemon=True)
+    consumer.start()
+    # srv.stop() must run even when the replay dies mid-stream (poisoned
+    # batcher, connection reset): serve_bench deliberately survives an
+    # ingest failure and goes on to measure the SLO cells in THIS
+    # process — leaked ingress/admitter threads would pollute them.
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        t0 = time.perf_counter()
+        sent = 0
+        try:
+            while sent + len(slab) <= frames * len(frame):
+                sock.sendall(slab)
+                sent += len(slab)
+            remainder = frames * len(frame) - sent
+            if remainder:
+                sock.sendall(frame * (remainder // len(frame)))
+        finally:
+            sock.close()
+        consumer.join(timeout=300)
+        span = time.perf_counter() - t0
+    finally:
+        srv.stop()
+    complete = drained["rows"] >= rows
+    payload_mb = frames * len(frame) / 1e6
+    return {
+        "serve_ingest_rows": rows,
+        "serve_ingest_frames": frames,
+        "serve_ingest_frame_rows": frame_rows,
+        "serve_ingest_features": features,
+        "serve_ingest_rows_per_sec": (
+            round(rows / span, 1) if complete and span > 0 else None
+        ),
+        "serve_ingest_mb_per_sec": (
+            round(payload_mb / span, 1) if complete and span > 0 else None
+        ),
+        "serve_ingest_seconds": round(span, 4),
+        "serve_ingest_complete": complete,
+    }
+
+
 def serve_bench(rows: int, rate: float, tenants: int = 1) -> None:
     import jax
 
     _enable_compile_cache(jax)
-    print(
-        json.dumps(
-            {
-                "metric": "serve_row_to_verdict",
-                "unit": "ms",
-                **_serve_stats(rows, rate, tenants),
-                **_adapt_stats(),
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    # The admission-only rider must not take down the SLO bench (or vice
+    # versa): each failure is recorded in its own field.
+    try:
+        ingest = _ingest_stats()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        ingest = {"serve_ingest_error": f"{type(e).__name__}: {e}"[:300]}
+    _emit(
+        {
+            "metric": "serve_row_to_verdict",
+            "unit": "ms",
+            **_serve_stats(rows, rate, tenants),
+            **_adapt_stats(),
+            **ingest,
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -1161,15 +1289,13 @@ def smoke() -> None:
         results_csv="",
         **({"collect": _CLI["collect"]} if _CLI["collect"] else {}),
     )
-    print(
-        json.dumps(
-            {
-                "metric": "rows_per_sec_chip",
-                "smoke": True,
-                **_headline_core(prepare(cfg), reps=3),
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    _emit(
+        {
+            "metric": "rows_per_sec_chip",
+            "smoke": True,
+            **_headline_core(prepare(cfg), reps=3),
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -1294,15 +1420,13 @@ def main() -> None:
     else:
         soak_stats = {"soak_skipped": "non-TPU device; use --soak explicitly"}
 
-    print(
-        json.dumps(
-            {
-                "metric": "rows_per_sec_chip",
-                **core,
-                **soak_stats,
-                "device": str(jax.devices()[0].platform),
-            }
-        )
+    _emit(
+        {
+            "metric": "rows_per_sec_chip",
+            **core,
+            **soak_stats,
+            "device": str(jax.devices()[0].platform),
+        }
     )
 
 
@@ -1377,15 +1501,13 @@ if __name__ == "__main__":
             metric = "serve_row_to_verdict"
         elif is_tenants:
             metric = "tenant_agg_rows_per_sec"
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": None,
-                    "unit": "rows/s",
-                    "vs_baseline": None,
-                    "error": f"{type(e).__name__}: {e}"[:300],
-                }
-            )
+        _emit(
+            {
+                "metric": metric,
+                "value": None,
+                "unit": "rows/s",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }
         )
         raise SystemExit(1)

@@ -288,6 +288,15 @@ class ServeParams(NamedTuple):
     linger_s: float = 0.25
     # Serving-loop poll granularity (batcher waits, stop checks).
     poll_s: float = 0.05
+    # Wire-protocol-v2 decoder bound: a binary frame header declaring
+    # more rows than this is malformed, not merely large — the ingress
+    # refuses it (ERR + connection close) BEFORE allocating its payload
+    # buffer, so a corrupt or hostile header cannot OOM the daemon.
+    # 0 = the codec's own default (serve.wire.MAX_FRAME_ROWS — the one
+    # copy of the constant; this jax-free module must not import it).
+    # The v1 text protocol has no equivalent knob (lines are admitted
+    # per recv block).
+    max_frame_rows: int = 0
     # Checkpoint path ('' = stateless serving): the detector carry +
     # stream-position meta, written atomically after every
     # ``checkpoint_every``-th published microbatch and at drain — the

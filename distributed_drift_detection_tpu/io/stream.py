@@ -443,6 +443,22 @@ class ChunkStriper:
         n = len(y)
         if n > self.span:
             raise ValueError(f"span of {n} rows exceeds chunk grid {self.span}")
+        if row_valid is None and n == self.span:
+            # Full clean span (the steady-state shape of a saturated v2
+            # serve ingress): padding is vacuous, so gather straight from
+            # the caller's arrays and skip the staging copy entirely.
+            # Bit-identical by construction — same gather map, and the
+            # staging path only differs on pad slots, of which there are
+            # none. Dtype mismatches fall through to the staging path
+            # (whose assignment performs the transport cast).
+            Xa, ya = np.asarray(X), np.asarray(y)
+            if (
+                Xa.dtype == self.feature_dtype
+                and ya.dtype == np.int32
+                and Xa.ndim == 2
+            ):
+                gmap, rows, valid = self._maps(n, start_row)
+                return Batches(X=Xa[gmap], y=ya[gmap], rows=rows, valid=valid)
         if row_valid is not None:
             row_valid = np.asarray(row_valid, bool)
             if row_valid.shape != (n,):

@@ -280,12 +280,7 @@ def corrupt_lines(
     if n == 0:
         return []
     out: list[tuple[int, int]] = []
-    used: set[int] = set()
-    for k in range(min(max(rows, 1), n)):
-        r = int(_unit_interval(seed, f"{kind}.row", k) * n) % n
-        while r in used:
-            r = (r + 1) % n
-        used.add(r)
+    for k, r in enumerate(corrupt_row_indices(kind, n, rows, seed)):
         fields = lines[r].split(",")
         if kind == "ragged_row":
             fields = fields[:-1] if len(fields) > 1 else fields + ["0"]
@@ -299,13 +294,35 @@ def corrupt_lines(
             fields[c] = f"{base}.5"
             out.append((r, c))
         else:  # nan_cell
-            c = int(_unit_interval(seed, f"{kind}.col", k) * len(fields)) % len(
-                fields
-            )
+            c = corrupt_cell_column(kind, seed, k, len(fields))
             fields[c] = "nan"
             out.append((r, c))
         lines[r] = ",".join(fields)
     return out
+
+
+def corrupt_row_indices(kind: str, n: int, rows: int, seed: int) -> list[int]:
+    """The seeded distinct-row selection behind :func:`corrupt_lines` —
+    the ONE copy of the hash keys and linear collision probing. The
+    loadgen v2 columnar stand-ins (``serve.loadgen.apply_dirty_frames``)
+    reuse it so a v1 and a v2 replay of the same ``--dirty`` spec dirty
+    the SAME stream positions — the cross-protocol verdict-parity
+    contract the ingress-v2-smoke CI job pins."""
+    out: list[int] = []
+    used: set[int] = set()
+    for k in range(min(max(rows, 1), n)):
+        r = int(_unit_interval(seed, f"{kind}.row", k) * n) % n
+        while r in used:
+            r = (r + 1) % n
+        used.add(r)
+        out.append(r)
+    return out
+
+
+def corrupt_cell_column(kind: str, seed: int, k: int, num_fields: int) -> int:
+    """The seeded column choice for the ``k``-th ``nan_cell`` corruption
+    (shared with the loadgen v2 stand-ins, like :func:`corrupt_row_indices`)."""
+    return int(_unit_interval(seed, f"{kind}.col", k) * num_fields) % num_fields
 
 
 def fire(site: str, *, file: str | None = None, fh=None, payload: str | None = None, lines: "list[str] | None" = None, label_col: int = -1, **context) -> None:

@@ -3,8 +3,11 @@
 The batch pipeline is load → run → exit; this package turns the same
 engines into a long-lived service:
 
-* :mod:`.ingress` — TCP line-protocol listener (CSV/JSON rows, ``FLUSH``
-  / ``STOP`` controls);
+* :mod:`.ingress` — one readiness-based event loop multiplexing every
+  connection; v1 text lines (CSV/JSON rows, ``FLUSH``/``STOP`` controls)
+  and v2 binary columnar frames auto-detected per message;
+* :mod:`.wire` — the v2 frame codec (length-prefixed binary columnar
+  frames: the wire twin of the ``[P, CB, B]`` grid);
 * :mod:`.admission` — sanitize-at-admission (the PR-5
   ``strict|quarantine|repair`` contract on live traffic) + the
   fixed-geometry :class:`~.admission.MicroBatcher` with a max-linger
@@ -33,6 +36,10 @@ _EXPORTS = {
     "find_verdicts": ".runner",
     "read_verdicts": ".runner",
     "run_loadgen": ".loadgen",
+    # wire protocol v2 (binary columnar frames)
+    "WireError": ".wire",
+    "encode_frame": ".wire",
+    "decode_frame": ".wire",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -46,8 +46,15 @@ from typing import NamedTuple
 import numpy as np
 
 from ..io import sanitize
-from ..io.stream import stripe_chunk
+from ..io.stream import ChunkStriper
 from ..resilience import faults
+
+
+class FrameContractError(ValueError):
+    """A v2 frame whose geometry disagrees with the daemon's row contract
+    (feature count / shape). Connection-level protocol violation — the
+    ingress validates before admission, so reaching this from the wire
+    means an embedder bug."""
 
 
 def _split_buffered(bufs, n_take: int, num_features: int):
@@ -164,6 +171,14 @@ class MicroBatcher:
         self.chunk_batches = chunk_batches
         self.rows_per_chunk = partitions * per_batch * chunk_batches
         self.shuffle_seed = shuffle_seed
+        # Pooled seal striper: same placement/shuffle/validity folding as
+        # stripe_chunk — bit-identical, pinned by test — but the pad
+        # staging buffers are reused across seals, so a sustained ingress
+        # (the v2 frame path especially) seals with zero per-chunk
+        # staging allocation.
+        self._striper = ChunkStriper(
+            partitions, per_batch, chunk_batches, shuffle_seed
+        )
         self.linger_s = linger_s
         self.start_row = int(start_row)  # next chunk's grid position
         self.chunk_index = int(chunk_index)
@@ -296,15 +311,8 @@ class MicroBatcher:
             self._X[0].shape[1],  # solo seals always hold data
         )
         take_X, take_y, take_ok, take_ts = take
-        chunk = stripe_chunk(
-            take_X,
-            take_y,
-            self.start_row,
-            self.partitions,
-            self.per_batch,
-            self.chunk_batches,
-            self.shuffle_seed,
-            row_valid=take_ok,
+        chunk = self._striper.stripe(
+            take_X, take_y, self.start_row, row_valid=take_ok
         )
         taken_before = self.rows_admitted - self._buffered
         meta = {
@@ -437,6 +445,12 @@ class TenantMicroBatcher:
                 f"{len(shuffle_seeds)} shuffle_seeds for {tenants} tenants"
             )
         self.shuffle_seeds = list(shuffle_seeds)
+        # One pooled seal striper per tenant (each has its own shuffle
+        # seed and staging pool) — see MicroBatcher.
+        self._stripers = [
+            ChunkStriper(partitions, per_batch, chunk_batches, s)
+            for s in self.shuffle_seeds
+        ]
         self.linger_s = linger_s
         self.start_rows = [
             int(s) for s in (start_rows or [0] * tenants)
@@ -606,15 +620,8 @@ class TenantMicroBatcher:
             )
             take_X, take_y, take_ok, take_ts = take
             blocks.append(
-                stripe_chunk(
-                    take_X,
-                    take_y,
-                    self.start_rows[t],
-                    self.partitions,
-                    self.per_batch,
-                    self.chunk_batches,
-                    self.shuffle_seeds[t],
-                    row_valid=take_ok,
+                self._stripers[t].stripe(
+                    take_X, take_y, self.start_rows[t], row_valid=take_ok
                 )
             )
             ts_parts.append(take_ts)
@@ -838,6 +845,80 @@ class AdmissionController:
         arr, issues = self._parse_block(lines)
         flagged = frozenset(i.row for i in issues)
         issues = issues + sanitize.scan_matrix(arr, self.tcol, flagged=flagged)
+        base = self.rows_seen
+        self.rows_seen += len(arr)
+        return self._admit_block_locked(
+            arr, issues, base, traces, rows=len(lines)
+        )
+
+    def admit_frame(self, X, y, traces=None) -> dict:
+        """Admit one v2 binary frame: columnar ``[n, F]`` f32 features +
+        ``[n]`` i32 labels (``serve.wire``), skipping the text parse
+        entirely. The overwhelmingly common clean frame admits with two
+        vectorized scans (finite cells, label domain) and **zero
+        copies** — the payload views push straight into the batcher,
+        which stripes them through its pooled staging buffers; a dirty
+        frame assembles the combined matrix once and flows through the
+        SAME ``scan_matrix`` → policy tail as text admission, so
+        strict/quarantine/repair semantics (positions, sidecar records,
+        counters, error text) are identical between the protocols.
+        Thread-safe (serialized), like :meth:`admit_lines`."""
+        with self._lock:
+            return self._admit_frame_locked(
+                np.asarray(X), np.asarray(y), traces
+            )
+
+    def _admit_frame_locked(self, X, y, traces=None) -> dict:
+        n = len(y)
+        if X.ndim != 2 or X.shape != (n, self.num_features):
+            raise FrameContractError(
+                f"frame shape {X.shape}/{y.shape} does not match the "
+                f"daemon's contract of {self.num_features} feature(s) "
+                "per row"
+            )
+        # Fault-injection site (resilience.faults; no-op unless armed):
+        # raise/timeout poison the batcher upstream exactly like the text
+        # path. The corruption kinds mutate text lines and are a no-op
+        # here — seed v2 dirt client-side (loadgen --wire v2 --dirty).
+        faults.fire("serve.ingress", rows_seen=self.rows_seen, frame_rows=n)
+        if n == 0:
+            return {"rows": 0, "admitted": 0, "error": None}
+        base = self.rows_seen
+        self.rows_seen += n
+        # Clean fast path: labels integral by wire construction, so the
+        # whole contract collapses to two vectorized checks. num_classes
+        # bounds the label far below the 2^24 f32-exactness clause.
+        clean = bool(
+            ((y >= 0) & (y < self.num_classes)).all()
+        ) and bool(np.isfinite(X).all())
+        if clean:
+            if self._stats is not None:
+                # Running repair stats want every admitted row as
+                # evidence (the one combined-matrix copy the repair
+                # policy pays; quarantine/strict daemons skip it).
+                arr = np.empty((n, self.columns), np.float32)
+                arr[:, : self.num_features] = X
+                arr[:, self.tcol] = y
+                self._stats.update(arr, None)
+            if self._c_rows is not None:
+                self._c_rows.inc(n)
+            self.batcher.push(X, y, None, traces or None)
+            return {"rows": n, "admitted": n, "error": None}
+        # Dirty frame (rare): assemble the combined matrix once and run
+        # the one shared policy tail — bit-identical semantics to text.
+        arr = np.empty((n, self.columns), np.float32)
+        arr[:, : self.num_features] = X
+        arr[:, self.tcol] = y
+        issues = sanitize.scan_matrix(arr, self.tcol)
+        return self._admit_block_locked(arr, issues, base, traces, rows=n)
+
+    def _admit_block_locked(
+        self, arr, issues, base: int, traces, *, rows: int
+    ) -> dict:
+        """The shared policy tail: label-domain clause + strict/
+        quarantine/repair resolution + stats/counters + batcher push.
+        One copy of these semantics — the v1 text and v2 frame paths must
+        not be able to drift apart."""
         # Serving-only contract clause: the label domain is configuration
         # (no re-indexing pass exists on a live stream). Checked on the
         # ROUNDED label — np.round is exactly what the repair policy will
@@ -862,8 +943,6 @@ class AdmissionController:
                 )
             )
         issues.sort(key=lambda i: (i.row, -1 if i.column is None else i.column))
-        base = self.rows_seen
-        self.rows_seen += len(arr)
 
         error = None
         ok = None
@@ -923,4 +1002,4 @@ class AdmissionController:
                 ok,
                 traces or None,
             )
-        return {"rows": len(lines), "admitted": admitted, "error": error}
+        return {"rows": rows, "admitted": admitted, "error": error}

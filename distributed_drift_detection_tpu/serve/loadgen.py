@@ -4,13 +4,21 @@
         --port 7007 --rows 4000 --rate 2000 --dir runs/live [...]
 
 Replays a stream — an ``io.synth`` spec (``synth:rialto,...``) or a CSV
-file — over the ingress line protocol at a target sustained rate, with
+file — over the serve ingress at a target sustained rate, with
 optional seeded dirty-row injection through the same
 ``resilience.faults.corrupt_lines`` helper the batch fault site uses
 (``--dirty nan_cell:5:7`` corrupts 5 seeded rows), then tails the
 daemon's verdict sidecar and reports **achieved rows/s plus p50/p99
 row→verdict latency** as one JSON line — the SLO evidence ``bench.py
 --serve`` records and the ``perf`` CLI tracks informationally.
+
+``--wire v2`` replays the same rows as **binary columnar frames**
+(``serve.wire``, ``--frame-rows`` rows each) instead of text lines —
+the device-speed admission path. Latency attribution is identical
+(verdict ``rows_through`` keys both protocols), ``--dirty`` corrupts
+the same seeded stream positions via columnar stand-ins
+(:func:`apply_dirty_frames`), and a multi-tenant replay deals the same
+round-robin blocks with the tenant id carried in each frame header.
 
 Tracing: ``--trace-sample R`` head-samples the replay at rate R — each
 sampled row is preceded by a ``TRACE <trace_id> <span_id>`` wire line
@@ -42,6 +50,8 @@ import sys
 import time
 
 import numpy as np
+
+from . import wire
 
 
 def load_source(
@@ -89,6 +99,67 @@ def apply_dirty(
     rows = int(parts[1]) if len(parts) > 1 else 1
     seed = int(parts[2]) if len(parts) > 2 else 0
     return corrupt_lines(lines, kind, rows=rows, seed=seed, label_col=-1)
+
+
+def apply_dirty_frames(
+    X: np.ndarray, y: np.ndarray, spec: str
+) -> list[tuple[int, int]]:
+    """The ``--wire v2`` twin of :func:`apply_dirty`: corrupt the replay
+    *arrays* in place with the SAME seeded row/column selection as
+    ``resilience.faults.corrupt_lines`` (the shared
+    ``corrupt_row_indices``/``corrupt_cell_column`` helpers), so a v1
+    and a v2 replay of one ``--dirty`` spec dirty the same stream
+    positions and their quarantine masks — hence their drift verdicts —
+    stay bit-identical.
+
+    A binary columnar frame cannot express text-only dirt, so two kinds
+    use **columnar stand-ins** that hit the same contract clause class:
+    ``bad_label`` (v1: non-integral label) and any dirt landing on the
+    label column become an out-of-domain label (``-1``; i32 labels are
+    integral by construction), and ``ragged_row`` becomes a whole-row
+    NaN fill + bad label (a frame is rectangular by construction). Under
+    ``quarantine``/``strict`` the affected rows resolve identically to
+    v1 (masked / rejected at the same positions); under ``repair`` the
+    v1 kinds may repair where the stand-ins quarantine — drive dirty
+    cross-protocol parity runs under ``quarantine`` (the default).
+    """
+    from ..resilience.faults import (
+        CORRUPTION_KINDS,
+        corrupt_cell_column,
+        corrupt_row_indices,
+    )
+
+    parts = spec.split(":")
+    kind = parts[0]
+    rows = int(parts[1]) if len(parts) > 1 else 1
+    seed = int(parts[2]) if len(parts) > 2 else 0
+    if kind not in CORRUPTION_KINDS:
+        raise ValueError(
+            f"unknown corruption kind {kind!r}; expected one of "
+            f"{sorted(CORRUPTION_KINDS)}"
+        )
+    n = len(y)
+    if n == 0:
+        return []
+    num_fields = X.shape[1] + 1  # corrupt_lines sees F+1 CSV fields
+    label_col = X.shape[1]
+    out: list[tuple[int, int]] = []
+    for k, r in enumerate(corrupt_row_indices(kind, n, rows, seed)):
+        if kind == "ragged_row":
+            X[r, :] = np.nan
+            y[r] = -1
+            out.append((r, -1))
+        elif kind == "bad_label":
+            y[r] = -1
+            out.append((r, label_col))
+        else:  # nan_cell
+            c = corrupt_cell_column(kind, seed, k, num_fields)
+            if c == label_col:
+                y[r] = -1
+            else:
+                X[r, c] = np.nan
+            out.append((r, c))
+    return out
 
 
 def sample_traces(
@@ -267,6 +338,39 @@ def _send_rows(
     return send_ts
 
 
+def _send_frames(
+    sock: socket.socket,
+    X: np.ndarray,
+    y: np.ndarray,
+    rate: float,
+    frame_rows: int = 1024,
+    label_lag: int = 0,
+    tenant: int = 0,
+) -> np.ndarray:
+    """Send the replay as v2 binary frames of up to ``frame_rows`` rows,
+    paced to ``rate`` rows/s (0 = as fast as the socket takes them);
+    returns per-row send wall-clock stamps. The frame-batched twin of
+    :func:`_send_rows` — same pacing math, same ``label_lag`` delayed-
+    labels shift, so latency attribution is identical across protocols."""
+    n = len(y)
+    send_ts = np.empty(n, np.float64)
+    start = time.monotonic()
+    i = 0
+    while i < n:
+        if rate > 0:
+            due = int((time.monotonic() - start) * rate) + 1 - label_lag
+            if due <= i:
+                time.sleep(min(0.002, 1.0 / rate))
+                continue
+            j = min(n, i + min(frame_rows, due - i))
+        else:
+            j = min(n, i + frame_rows)
+        sock.sendall(wire.encode_frame(X[i:j], y[i:j], tenant=tenant))
+        send_ts[i:j] = time.time()
+        i = j
+    return send_ts
+
+
 def _run_loadgen_tenants(
     host: str,
     port: int,
@@ -284,6 +388,8 @@ def _run_loadgen_tenants(
     trace_ctx: "dict[int, tuple[str, str]] | None" = None,
     trace_log=None,
     label_lag: int = 0,
+    wire_version: str = "v1",
+    arrays=None,
 ) -> dict:
     """Multi-tenant replay: the stream is dealt round-robin (blocks of
     ``interleave`` rows) across T tenant slots over ONE connection, with
@@ -292,14 +398,18 @@ def _run_loadgen_tenants(
     is per tenant: a verdict record's ``tenants[k].rows_through`` maps
     tenant k's sent rows exactly as ``rows_through`` does on a solo
     daemon; the pooled per-row latencies feed one p50/p99 pair (the SLO
-    covers the plane, not one tenant)."""
-    # Deal lines into tenant streams (round-robin blocks) and build the
-    # wire segments: (tenant, [lines]) in send order.
+    covers the plane, not one tenant). ``wire_version='v2'`` ships each
+    dealt block as ONE binary frame carrying its tenant id (the frame
+    header routes instead of a TENANT line) — identical dealing, so
+    per-tenant streams match the v1 replay row for row."""
+    n_rows = len(arrays[1]) if wire_version == "v2" else len(lines)
+    # Deal rows into tenant streams (round-robin blocks) and build the
+    # wire segments: (tenant, [row indices]) in send order.
     streams: list[list[int]] = [[] for _ in range(tenants)]
     segments: list[tuple[int, list[int]]] = []
-    for base in range(0, len(lines), interleave):
+    for base in range(0, n_rows, interleave):
         t = (base // interleave) % tenants
-        idx = list(range(base, min(base + interleave, len(lines))))
+        idx = list(range(base, min(base + interleave, n_rows)))
         streams[t].extend(idx)
         segments.append((t, idx))
     tail = _VerdictTail(verdicts) if verdicts else None
@@ -312,9 +422,11 @@ def _run_loadgen_tenants(
                     baselines[k] = max(
                         baselines[k], int(ent["rows_through"])
                     )
-    wire = _stamp_lines(lines, trace_ctx or {})
+    stamped = (
+        _stamp_lines(lines, trace_ctx or {}) if wire_version == "v1" else None
+    )
     sock = _connect(host, port, connect_timeout)
-    send_ts = np.empty(len(lines), np.float64)
+    send_ts = np.empty(n_rows, np.float64)
     sent_so_far = 0
     try:
         t0 = time.monotonic()
@@ -323,22 +435,32 @@ def _run_loadgen_tenants(
                 # label_lag: same delayed-labels pace shift as _send_rows
                 while sent_so_far + label_lag > (time.monotonic() - t0) * rate:
                     time.sleep(min(0.002, 1.0 / rate))
-            payload = (
-                f"TENANT {t}\n"
-                + "\n".join(wire[i] for i in idx)
-                + "\n"
-            )
-            sock.sendall(payload.encode())
+            if wire_version == "v2":
+                X, y = arrays
+                sock.sendall(
+                    wire.encode_frame(X[idx], y[idx], tenant=t)
+                )
+            else:
+                payload = (
+                    f"TENANT {t}\n"
+                    + "\n".join(stamped[i] for i in idx)
+                    + "\n"
+                )
+                sock.sendall(payload.encode())
             send_ts[idx] = time.time()
             sent_so_far += len(idx)
         sent_span = time.monotonic() - t0
         if flush:
-            sock.sendall(b"FLUSH\n")
+            sock.sendall(
+                wire.encode_flush() if wire_version == "v2" else b"FLUSH\n"
+            )
         if stop:
-            sock.sendall(b"STOP\n")
+            sock.sendall(
+                wire.encode_stop() if wire_version == "v2" else b"STOP\n"
+            )
     finally:
         sock.close()
-    sent = len(lines)
+    sent = n_rows
     expects = [b + len(s) for b, s in zip(baselines, streams)]
     # expect_rows (same contract as the solo path): override how many
     # TOTAL rows the verdict stream must cover before the probe stops
@@ -445,6 +567,9 @@ def run_loadgen(
     trace_seed: int = 0,
     trace_log=None,
     label_lag: int = 0,
+    wire_version: str = "v1",
+    arrays=None,
+    frame_rows: int = 1024,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
@@ -457,8 +582,25 @@ def run_loadgen(
     one root ``ingress`` span per sampled-and-covered row.
     ``label_lag`` replays with labels arriving K rows late (see
     :func:`_send_rows`) — the realistic shape adaptation refits are
-    exercised under."""
-    trace_ctx = sample_traces(len(lines), trace_sample, trace_seed)
+    exercised under. ``wire_version='v2'`` replays as binary columnar
+    frames of ``frame_rows`` rows (``serve.wire``): ``arrays=(X, y)``
+    supplies the row data (``lines`` may be None), verdict attribution
+    is unchanged (``rows_through`` keys both protocols identically)."""
+    if wire_version not in ("v1", "v2"):
+        raise ValueError(f"wire_version must be 'v1' or 'v2', got {wire_version!r}")
+    if wire_version == "v2":
+        if arrays is None:
+            raise ValueError("wire_version='v2' needs arrays=(X, y)")
+        if trace_sample > 0:
+            # TRACE stamps are text-protocol lines; the v2 trace source
+            # is the daemon-side sampler (ServeParams.trace_sample).
+            raise ValueError(
+                "client-side trace sampling needs wire_version='v1'"
+            )
+    n_rows = len(arrays[1]) if wire_version == "v2" else len(lines)
+    trace_ctx = sample_traces(
+        n_rows if wire_version == "v1" else 0, trace_sample, trace_seed
+    )
     if tenants > 1:
         return _run_loadgen_tenants(
             host, port, lines, tenants,
@@ -466,6 +608,7 @@ def run_loadgen(
             stop=stop, connect_timeout=connect_timeout,
             expect_rows=expect_rows, trace_ctx=trace_ctx,
             trace_log=trace_log, label_lag=label_lag,
+            wire_version=wire_version, arrays=arrays,
         )
     tail = _VerdictTail(verdicts) if verdicts else None
     baseline = 0
@@ -477,17 +620,28 @@ def run_loadgen(
     sock = _connect(host, port, connect_timeout)
     try:
         t0 = time.monotonic()
-        send_ts = _send_rows(
-            sock, _stamp_lines(lines, trace_ctx), rate, label_lag=label_lag
-        )
+        if wire_version == "v2":
+            send_ts = _send_frames(
+                sock, arrays[0], arrays[1], rate,
+                frame_rows=frame_rows, label_lag=label_lag,
+            )
+        else:
+            send_ts = _send_rows(
+                sock, _stamp_lines(lines, trace_ctx), rate,
+                label_lag=label_lag,
+            )
         sent_span = time.monotonic() - t0
         if flush:
-            sock.sendall(b"FLUSH\n")
+            sock.sendall(
+                wire.encode_flush() if wire_version == "v2" else b"FLUSH\n"
+            )
         if stop:
-            sock.sendall(b"STOP\n")
+            sock.sendall(
+                wire.encode_stop() if wire_version == "v2" else b"STOP\n"
+            )
     finally:
         sock.close()
-    sent = len(lines)
+    sent = n_rows
     expect = baseline + (expect_rows if expect_rows is not None else sent)
     records: list[dict] = []
     covered = baseline
@@ -560,10 +714,20 @@ def main(argv=None) -> None:
                     help="deal the replay round-robin across N tenant "
                     "slots of a multi-tenant daemon (TENANT wire lines, "
                     "per-tenant latency attribution)")
+    ap.add_argument("--wire", choices=("v1", "v2"), default="v1",
+                    help="wire protocol: v1 = text lines (default), "
+                    "v2 = binary columnar frames (serve.wire) — "
+                    "frame-batched replay at device-feed rates, same "
+                    "latency attribution")
+    ap.add_argument("--frame-rows", type=int, default=1024,
+                    help="rows per v2 frame (--wire v2; multi-tenant "
+                    "replays deal interleave-sized frames instead)")
     ap.add_argument("--dirty", action="append", default=[],
                     metavar="KIND[:ROWS[:SEED]]",
                     help="seeded dirty-row injection (nan_cell|bad_label|"
-                    "ragged_row), repeatable")
+                    "ragged_row), repeatable; --wire v2 corrupts the same "
+                    "seeded stream positions with columnar stand-ins "
+                    "(NaN cells / out-of-domain labels)")
     ap.add_argument("--verdicts", default=None,
                     help="verdict sidecar path (row→verdict latency source)")
     ap.add_argument("--dir", dest="telemetry_dir", default=None,
@@ -593,10 +757,22 @@ def main(argv=None) -> None:
     X, y, num_classes = load_source(args.source, args.target_column)
     if args.rows is not None:
         X, y = X[: args.rows], y[: args.rows]
-    lines = format_lines(X, y)
     dirty_rows = 0
-    for spec in args.dirty:
-        dirty_rows += len(apply_dirty(lines, spec))
+    if args.wire == "v2":
+        if args.trace_sample > 0:
+            ap.error(
+                "--trace-sample needs --wire v1 (TRACE stamps are text "
+                "protocol lines; use the daemon's --trace-sample for v2)"
+            )
+        X = np.ascontiguousarray(X, np.float32)
+        y = np.ascontiguousarray(y, np.int32)
+        for spec in args.dirty:
+            dirty_rows += len(apply_dirty_frames(X, y, spec))
+        lines = None
+    else:
+        lines = format_lines(X, y)
+        for spec in args.dirty:
+            dirty_rows += len(apply_dirty(lines, spec))
     verdicts = args.verdicts
     if verdicts is None and args.telemetry_dir:
         from .runner import find_verdicts
@@ -631,9 +807,13 @@ def main(argv=None) -> None:
         trace_seed=args.trace_seed,
         trace_log=trace_log,
         label_lag=args.delayed_labels,
+        wire_version=args.wire,
+        arrays=(X, y) if args.wire == "v2" else None,
+        frame_rows=args.frame_rows,
     )
     report.update(
         source=args.source,
+        wire=args.wire,
         features=int(X.shape[1]),
         classes=num_classes,
         dirty_rows=dirty_rows,

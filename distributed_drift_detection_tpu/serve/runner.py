@@ -472,6 +472,8 @@ class ServeRunner:
                 self.batcher,
                 self.request_stop,
                 sampler=self._sampler,
+                metrics=self._metrics,
+                max_frame_rows=params.max_frame_rows,
             )
             self._ingress.start()
         # SLO engine + evaluator thread: the judge must not live on the
@@ -500,6 +502,9 @@ class ServeRunner:
             "serving": True,
             "tenants": self.tenants,
             "host": params.host,
+            # both wire protocols are always live on the socket — the
+            # per-connection state machine auto-detects per message
+            "wire": ["v1", "v2"] if self._ingress is not None else None,
             "port": self._ingress.port if self._ingress is not None else None,
             "ops_port": self._ops.port if self._ops is not None else None,
             "pid": os.getpid(),
@@ -626,6 +631,11 @@ class ServeRunner:
                 "inflight": self._inflight_n,
                 **(batcher.depth() if batcher is not None else {}),
             },
+            # Per-protocol ingress accounting (frames_v1/frames_v2/
+            # decode_errors/connections); None on socketless embeddings.
+            "ingress": (
+                self._ingress.stats() if self._ingress is not None else None
+            ),
             "detections": self._detections,
             "last_verdict_age_s": (
                 None
@@ -1061,6 +1071,11 @@ def main(argv=None) -> None:
                     help="TCP ingress port (0 = OS-assigned, see banner)")
     ap.add_argument("--linger-s", type=float, default=0.25,
                     help="max wait before a partial microbatch flushes short")
+    ap.add_argument("--max-frame-rows", type=int, default=0,
+                    help="wire-v2 decoder bound: a binary frame header "
+                    "declaring more rows is refused (ERR + close) before "
+                    "any allocation (0 = the codec default, "
+                    "serve.wire.MAX_FRAME_ROWS)")
     ap.add_argument("--heartbeat-s", type=float, default=10.0)
     ap.add_argument("--data-policy", default="quarantine",
                     choices=DATA_POLICIES,
@@ -1150,6 +1165,7 @@ def main(argv=None) -> None:
         port=args.port,
         chunk_batches=args.chunk_batches,
         linger_s=args.linger_s,
+        max_frame_rows=args.max_frame_rows,
         checkpoint=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         heartbeat_s=args.heartbeat_s,

@@ -121,6 +121,16 @@ CELLS = (
     ("serve_p99_ms", _DOWN, True, "ms"),
     ("serve_registry_p50_ms", _DOWN, False, "ms"),
     ("serve_registry_p99_ms", _DOWN, False, "ms"),
+    # Serve-ingress admission rate (bench.py --serve ingest rider, r13+):
+    # v2 binary frames through the real loopback socket → event-loop
+    # ingress → vectorized frame admission → pooled-striper seals, with
+    # NO device feed — the admission-only ceiling of the serve path.
+    # GATED: sustaining ≥10M rows/s here is the wire-v2 tentpole's whole
+    # claim, and a regression is a code property of the ingress/admission
+    # pipeline (the serve_* stall markers apply — a wedged host reports
+    # suspect, never gates). The MB/s twin prints informationally.
+    ("serve_ingest_rows_per_sec", _UP, True, "rows/s"),
+    ("serve_ingest_mb_per_sec", _UP, False, "MB/s"),
     # Adaptation recovery (bench.py --serve adapt rider, r12+): rows from
     # a drift verdict until post-drift chunk error returns within the
     # policy's epsilon of the pre-drift level, on the planted
@@ -140,53 +150,172 @@ class ArtifactError(ValueError):
     """The file holds no recoverable bench JSON."""
 
 
+# --- the summary-line contract ---------------------------------------------
+#
+# bench.py emits ONE machine-parseable JSON line per invocation, and the
+# round driver archives only the last ~2 KB of stdout — BENCH_r05.json
+# recorded `parsed: null` because the headline line outgrew that window
+# and the driver's last-line parse found a head-truncated fragment. The
+# contract is therefore: the FINAL stdout line must carry every cell the
+# perf CLI gates on and stay within SUMMARY_LINE_BUDGET bytes. When the
+# full artifact line is bigger, bench.py prints it first (humans, full
+# archives) and then a trimmed final line — the gate-relevant subset plus
+# `"trimmed": true` — and load_bench() below re-merges the pair (trimmed
+# wins) so nothing is lost when the full line survives. Emitter and
+# parser live together here so they cannot drift apart.
+
+SUMMARY_LINE_BUDGET = 1900
+
+#: Keys the trimmed final line must carry: every perf cell, the fields
+#: cells derive from, and the provenance markers the gating logic reads.
+SUMMARY_KEYS = tuple(c for c, _, _, _ in CELLS) + (
+    "metric",
+    "unit",
+    "trimmed",
+    "rows",
+    "rep_times_s",
+    "stalled_reps",
+    "contended",
+    "smoke",
+    "device",
+    "error",
+    "vs_baseline",
+    "serve_timeout",
+    "serve_drained",
+    "serve_ingest_error",
+    # nested dicts bench_cells() extracts from
+    "compile_s",
+    "phase_median_s",
+    "cold_vs_warm_compile_s",
+    "chunked_pipeline_s",
+    "xla",
+)
+
+#: Dropped from an over-budget trimmed line in this order (informational
+#: cells first) until it fits — the gated scalars always survive.
+_SUMMARY_DROP_ORDER = (
+    "xla",
+    "chunked_pipeline_s",
+    "phase_median_s",
+    "cold_vs_warm_compile_s",
+    "compile_s",
+    "rep_times_s",
+    "stalled_reps",
+)
+
+
+def summary_lines(bench: dict, budget: int = SUMMARY_LINE_BUDGET) -> list[str]:
+    """The stdout lines for one bench artifact under the summary-line
+    contract: ``[full]`` when the artifact fits ``budget``, else
+    ``[full, trimmed]`` with the trimmed line guaranteed to fit and to
+    carry every gated cell (see module comment). bench.py routes every
+    mode's final print through this."""
+    full = json.dumps(bench)
+    if len(full) <= budget:
+        return [full]
+    trimmed = {k: bench[k] for k in SUMMARY_KEYS if k in bench}
+    trimmed["trimmed"] = True
+    line = json.dumps(trimmed)
+    for key in _SUMMARY_DROP_ORDER:
+        if len(line) <= budget:
+            break
+        if trimmed.pop(key, None) is not None:
+            line = json.dumps(trimmed)
+    return [full, line]
+
+
+def _scan_lines(lines: list[str], path: str) -> tuple[dict, list[str]]:
+    """Reversed scan of stdout/tail lines for the bench dict.
+
+    Handles the full summary-line contract: a trimmed final line
+    (``"trimmed": true``) re-merges with the full artifact line above it
+    (trimmed wins on conflicts — it is the newer emission); a
+    head-truncated full line (the driver kept only the last N bytes) is
+    repaired by re-opening the brace and dropping the first, garbled key.
+    """
+    notes: list[str] = []
+    trimmed: "dict | None" = None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            # Head-truncated capture, cutting mid-line. Re-open the
+            # object and drop the first key — its name is unknowable
+            # (the cut may have landed inside it), so its value cannot
+            # be trusted either.
+            try:
+                fixed = json.loads('{"' + line.lstrip('{",'))
+            except json.JSONDecodeError:
+                continue
+            garbled = next(iter(fixed), None)
+            if garbled is not None:
+                fixed.pop(garbled)
+            notes.append(
+                "recovered from head-truncated tail "
+                f"(dropped garbled first key {garbled!r})"
+            )
+            if trimmed is not None:
+                notes.append(
+                    "merged trimmed summary line with the recovered "
+                    "full line"
+                )
+                return {**fixed, **trimmed}, notes
+            return fixed, notes
+        # a stray scalar line ('0', 'true', an exit-code echo) is valid
+        # JSON but not a bench dict — keep scanning upward
+        if not isinstance(parsed, dict):
+            continue
+        if parsed.get("trimmed") and trimmed is None:
+            trimmed = parsed  # keep scanning for the full line above
+            continue
+        if trimmed is not None:
+            return {**parsed, **trimmed}, [
+                "merged trimmed summary line with full artifact line"
+            ]
+        return parsed, notes
+    if trimmed is not None:
+        return trimmed, notes + [
+            "trimmed summary line only (full artifact line not captured)"
+        ]
+    raise ArtifactError(f"{path}: no recoverable bench JSON line")
+
+
 def load_bench(path: str) -> tuple[dict, list[str]]:
     """Load one bench artifact → ``(bench dict, provenance notes)``."""
     with open(path) as fh:
         text = fh.read()
     try:
         obj = json.loads(text)
-    except json.JSONDecodeError as e:
-        raise ArtifactError(f"{path}: not JSON ({e})") from None
+    except json.JSONDecodeError:
+        # Raw multi-line bench stdout (the summary-line contract emits
+        # full + trimmed lines when the artifact outgrows the budget).
+        return _scan_lines(text.splitlines(), path)
     if not isinstance(obj, dict):
         raise ArtifactError(f"{path}: expected a JSON object")
     if "metric" in obj or "value" in obj:
         return obj, []  # the raw bench line
     if "parsed" in obj or "tail" in obj:  # driver wrapper
-        if isinstance(obj.get("parsed"), dict):
-            return obj["parsed"], []
-        lines = (obj.get("tail") or "").strip().splitlines()
-        for line in reversed(lines):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                parsed = json.loads(line)
-                # a stray scalar line ('0', 'true', an exit-code echo) is
-                # valid JSON but not a bench dict — keep scanning upward
-                if isinstance(parsed, dict):
-                    return parsed, []
-                continue
-            except json.JSONDecodeError:
-                # Head-truncated capture: the wrapper kept the last N bytes
-                # only, cutting mid-line. Re-open the object and drop the
-                # first key — its name is unknowable (the cut may have
-                # landed inside it), so its value cannot be trusted either.
-                try:
-                    fixed = json.loads('{"' + line.lstrip('{",'))
-                except json.JSONDecodeError:
-                    continue
-                garbled = next(iter(fixed), None)
-                if garbled is not None:
-                    fixed.pop(garbled)
-                return fixed, [
-                    "recovered from head-truncated tail "
-                    f"(dropped garbled first key {garbled!r})"
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict) and not parsed.get("trimmed"):
+            return parsed, []
+        # `parsed` may be the trimmed final line (the driver parses only
+        # the last line) — scan the tail to merge with the full line.
+        try:
+            return _scan_lines(
+                (obj.get("tail") or "").strip().splitlines(), path
+            )
+        except ArtifactError:
+            if isinstance(parsed, dict):
+                return parsed, [
+                    "trimmed summary line only (tail unrecoverable)"
                 ]
-        raise ArtifactError(
-            f"{path}: wrapper holds no recoverable bench JSON "
-            f"(rc={obj.get('rc')})"
-        )
+            raise ArtifactError(
+                f"{path}: wrapper holds no recoverable bench JSON "
+                f"(rc={obj.get('rc')})"
+            ) from None
     raise ArtifactError(f"{path}: not a bench artifact or driver wrapper")
 
 
@@ -283,6 +412,8 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "serve_p99_ms",
         "serve_registry_p50_ms",
         "serve_registry_p99_ms",
+        "serve_ingest_rows_per_sec",
+        "serve_ingest_mb_per_sec",
         "serve_adapt_recovery_rows",
         "mean_delay_batches",
         "detections",

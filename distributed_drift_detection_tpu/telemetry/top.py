@@ -89,6 +89,7 @@ class LogSource:
             "p99_ms": None,
             "detections": s.detections,
             "quarantined": self.quarantined,
+            "wire": None,  # per-protocol counters live on /statusz only
             "alerts": sorted(s.alerts),
             "age_s": age,
         }
@@ -118,6 +119,7 @@ class StatuszSource:
                 "p99_ms": None,
                 "detections": None,
                 "quarantined": None,
+                "wire": None,
                 "alerts": [f"unreachable: {getattr(e, 'reason', e)}"],
                 "age_s": None,
             }
@@ -129,6 +131,15 @@ class StatuszSource:
             lambda: rows / s["uptime_s"] if rows and s.get("uptime_s") else None,
         )
         lat = s.get("latency_ms") or {}
+        # Per-protocol ingress mix ("v1:12 v2:340[ err:2]") from the
+        # /statusz ingress section (serve ingress counters); socketless
+        # embeddings report None there and the column stays "-".
+        ingress = s.get("ingress") or None
+        wire = None
+        if ingress is not None:
+            wire = f"v1:{ingress.get('frames_v1', 0)} v2:{ingress.get('frames_v2', 0)}"
+            if ingress.get("decode_errors"):
+                wire += f" err:{ingress['decode_errors']}"
         return {
             "run": s.get("run_id") or self.url,
             "status": "draining" if s.get("draining") else "live",
@@ -138,6 +149,7 @@ class StatuszSource:
             "p99_ms": lat.get("p99"),
             "detections": s.get("detections"),
             "quarantined": (s.get("rows") or {}).get("quarantined"),
+            "wire": wire,
             "alerts": sorted(a["rule"] for a in s.get("alerts") or []),
             "age_s": s.get("last_verdict_age_s"),
         }
@@ -152,6 +164,7 @@ _COLUMNS = (
     ("P99ms", "p99_ms", 10),
     ("DET", "detections", 7),
     ("QUAR", "quarantined", 7),
+    ("WIRE", "wire", 16),
     ("AGE", "age_s", 7),
     ("ALERTS", "alerts", 0),
 )

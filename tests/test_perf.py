@@ -119,7 +119,9 @@ def test_load_rejects_non_artifacts(tmp_path):
     bad = str(tmp_path / "bad.json")
     with open(bad, "w") as fh:
         fh.write("not json at all")
-    with pytest.raises(ArtifactError, match="not JSON"):
+    # non-JSON text now routes through the line scanner (raw bench
+    # stdout may legitimately hold two summary lines) — still rejected
+    with pytest.raises(ArtifactError, match="no recoverable"):
         load_bench(bad)
     with pytest.raises(ArtifactError, match="not a bench artifact"):
         load_bench(_write(tmp_path, "other.json", {"hello": 1}))
@@ -285,3 +287,106 @@ def test_cold_vs_warm_compile_cells_informational():
         [("warm", warm, []), ("cold", cold, [])], tolerance=0.10
     )
     assert not [r for r in regs if r.cell.startswith("compile_cold")]
+
+
+# ---------------------------------------------------------------------------
+# The summary-line contract (ISSUE 13 satellite: BENCH_r05 parsed: null)
+# ---------------------------------------------------------------------------
+
+
+def _big_artifact():
+    from distributed_drift_detection_tpu.telemetry.perf import (
+        SUMMARY_LINE_BUDGET,
+    )
+
+    return {
+        "metric": "rows_per_sec_chip",
+        "unit": "rows/s",
+        "value": 3.0e6,
+        "final_time_s": 0.67,
+        "detect_time_s": 0.54,
+        "rows": 2_048_000,
+        "rep_times_s": [0.5] * 15,
+        "serve_ingest_rows_per_sec": 1.45e7,
+        "soak_value": 1.08e8,
+        "xla": {"flops": 1e12, "bytes_accessed": 1e9},
+        # filler standing in for phase_s/phase_hist bulk — guarantees the
+        # full line outgrows the driver's tail window
+        "phase_hist": {"detect": list(range(400))},
+        "pad": "z" * (SUMMARY_LINE_BUDGET + 500),
+    }
+
+
+def test_summary_lines_trim_when_over_budget():
+    from distributed_drift_detection_tpu.telemetry.perf import (
+        SUMMARY_LINE_BUDGET,
+        summary_lines,
+    )
+
+    small = {"metric": "m", "value": 1.0}
+    assert summary_lines(small) == [json.dumps(small)]
+
+    lines = summary_lines(_big_artifact())
+    assert len(lines) == 2
+    assert json.loads(lines[0])["pad"]  # the full line survives intact
+    trimmed = json.loads(lines[1])
+    assert trimmed["trimmed"] is True
+    assert len(lines[1]) <= SUMMARY_LINE_BUDGET
+    # every gated cell the artifact carries rides the FINAL line
+    for key in ("value", "final_time_s", "detect_time_s", "soak_value",
+                "serve_ingest_rows_per_sec"):
+        assert trimmed[key] == _big_artifact()[key], key
+    assert "pad" not in trimmed and "phase_hist" not in trimmed
+
+
+def test_load_bench_merges_trimmed_with_full_line(tmp_path):
+    """Raw two-line bench stdout: the parser re-merges full + trimmed."""
+    from distributed_drift_detection_tpu.telemetry.perf import summary_lines
+
+    lines = summary_lines(_big_artifact())
+    path = str(tmp_path / "two-line.json")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    bench, notes = load_bench(path)
+    assert bench["value"] == 3.0e6 and bench["pad"]  # merged, nothing lost
+    assert any("merged trimmed" in n for n in notes)
+
+
+def test_load_bench_driver_tail_truncation_regression(tmp_path):
+    """The BENCH_r05 failure shape, post-fix: the driver keeps only the
+    last ~2 KB of stdout and parses the last line. With the trimmed
+    final line the wrapper recovers every gated cell — including the
+    new serve_ingest_rows_per_sec — even though the full line was
+    head-truncated away."""
+    from distributed_drift_detection_tpu.telemetry.perf import summary_lines
+
+    out = "\n".join(summary_lines(_big_artifact())) + "\n"
+    wrapper = {"cmd": "bench.py", "rc": 0, "tail": out[-2000:], "parsed": None}
+    path = _write(tmp_path, "wrapped.json", wrapper)
+    bench, notes = load_bench(path)
+    assert bench["serve_ingest_rows_per_sec"] == 1.45e7
+    assert bench["value"] == 3.0e6
+    cells, _ = bench_cells(bench)
+    assert cells["serve_ingest_rows_per_sec"] == 1.45e7
+
+    # a driver that DID parse the trimmed last line: still recovered
+    wrapper2 = dict(wrapper, parsed=json.loads(out.strip().splitlines()[-1]))
+    bench2, _ = load_bench(_write(tmp_path, "wrapped2.json", wrapper2))
+    assert bench2["serve_ingest_rows_per_sec"] == 1.45e7
+
+
+def test_serve_ingest_cell_gates():
+    """serve_ingest_rows_per_sec is a GATED cell: a >tolerance drop
+    fails the diff; the serve stall markers make it suspect instead."""
+    old = {"metric": "serve_row_to_verdict", "serve_ingest_rows_per_sec": 1.4e7}
+    new = {"metric": "serve_row_to_verdict", "serve_ingest_rows_per_sec": 0.9e7}
+    _, regs = diff_benches(
+        [("old", old, []), ("new", new, [])], tolerance=0.10
+    )
+    assert [r.cell for r in regs] == ["serve_ingest_rows_per_sec"]
+    assert not regs[0].suspect
+    _, regs = diff_benches(
+        [("old", old, []), ("new", dict(new, serve_timeout=True), [])],
+        tolerance=0.10,
+    )
+    assert regs and regs[0].suspect  # wedged host: reported, never gating
