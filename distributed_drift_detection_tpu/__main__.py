@@ -104,6 +104,7 @@ _USAGE = (
     "[URL INSTANCES MEMORY CORES TIME_STRING MULT_DATA [DATASET]]\n"
     "       python -m distributed_drift_detection_tpu serve --features F --classes C [...]\n"
     "       python -m distributed_drift_detection_tpu loadgen SOURCE --port P [...]\n"
+    "       python -m distributed_drift_detection_tpu router --backend H:P:OP [...]\n"
     "       python -m distributed_drift_detection_tpu report RUN_JSONL [...]\n"
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
@@ -206,6 +207,13 @@ def main(argv: list[str]) -> None:
         from .serve.loadgen import main as loadgen_main
 
         loadgen_main(argv[1:])
+        return
+    if argv and argv[0] == "router":
+        # jax-free: the fleet front daemon routes tenants across N
+        # serving daemons with live migration (serve.router).
+        from .serve.router import main as router_main
+
+        router_main(argv[1:])
         return
 
     argv = list(argv)

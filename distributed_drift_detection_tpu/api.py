@@ -110,7 +110,7 @@ _RUNNER_CACHE: OrderedDict = OrderedDict()
 
 def _cached_runner(
     cfg: RunConfig, spec: ModelSpec, n_dev: int, indexed: bool, model,
-    compact_capacity: int = 0,
+    compact_capacity: int = 0, tenant_devices: int = 0,
 ):
     """Returns ``(runner, mesh, compile_info)`` — see PreparedRun.compile_info."""
 
@@ -118,7 +118,11 @@ def _cached_runner(
         from .ops.detectors import make_detector
 
         t0 = time.perf_counter()
-        mesh = make_mesh(n_dev) if n_dev > 1 else None
+        mesh = (
+            make_mesh(n_dev, tenant_devices=tenant_devices)
+            if n_dev > 1
+            else None
+        )
         runner = make_mesh_runner(
             model,
             cfg.ddm,
@@ -155,6 +159,7 @@ def _cached_runner(
         cfg.window, indexed, n_dev, cfg.retrain_error_threshold,
         cfg.detector, cfg.ph, cfg.eddm, cfg.hddm, cfg.hddm_w, cfg.adwin,
         cfg.kswin, cfg.stepd, cfg.window_rotations, compact_capacity,
+        tenant_devices,
     )
     if key in _RUNNER_CACHE:
         _RUNNER_CACHE.move_to_end(key)
@@ -371,6 +376,24 @@ def _build_runner(cfg: RunConfig, spec, model, nb: int, indexed: bool = False):
     # cluster existed).
     while n_dev > 1 and cfg.partitions % n_dev:
         n_dev -= 1
+    # Tenant mesh axis (ROADMAP item 1): an EXPLICIT mesh_tenant_devices
+    # is a sharding request, so its constraints fail loudly instead of
+    # silently falling back — the flags are bit-identical at every shape,
+    # but the operator asked for THIS one.
+    tenant_devices = int(cfg.mesh_tenant_devices or 0)
+    if tenant_devices > 1:
+        tenants = max(int(cfg.tenants), 1)
+        if tenants % tenant_devices:
+            raise ValueError(
+                f"{tenants} tenant(s) do not split over the requested "
+                f"{tenant_devices}-row tenant mesh axis "
+                "(mesh_tenant_devices must divide tenants)"
+            )
+        if n_dev % tenant_devices:
+            raise ValueError(
+                f"{n_dev} usable device(s) do not split over the "
+                f"requested {tenant_devices}-row tenant mesh axis"
+            )
     # Compaction epilogue capacity (tentpole a): sized from the stripe
     # geometry unless pinned; 0 (= full-plane collect) for the escape
     # hatches — collect='full' and validate=True, whose structural audit
@@ -382,7 +405,8 @@ def _build_runner(cfg: RunConfig, spec, model, nb: int, indexed: bool = False):
     else:
         capacity = 0
     return _cached_runner(
-        cfg, spec, n_dev, indexed, model, compact_capacity=capacity
+        cfg, spec, n_dev, indexed, model, compact_capacity=capacity,
+        tenant_devices=tenant_devices if tenant_devices > 1 else 0,
     )
 
 
@@ -484,7 +508,7 @@ def _kernel_identity(cfg: RunConfig) -> tuple:
         cfg.hddm, cfg.hddm_w, cfg.adwin, cfg.kswin, cfg.stepd,
         cfg.window_rotations, cfg.shuffle_batches, cfg.collect,
         cfg.collect_capacity, cfg.validate, cfg.backend,
-        cfg.mesh_devices,
+        cfg.mesh_devices, cfg.mesh_tenant_devices,
     )
 
 
@@ -792,6 +816,7 @@ def prepare_chunked(
     chunk_batches: int = 4,
     mesh=None,
     validate: bool = False,
+    tenant_seeds=None,
 ):
     """Streaming twin of :func:`prepare`: a RunConfig → an AOT-warmed
     :class:`~..engine.chunked.ChunkedDetector` ready to serve traffic.
@@ -814,7 +839,10 @@ def prepare_chunked(
     twin of :func:`prepare_multi`: one ``[T·P, CB, B]`` chunk program
     whose per-tenant state blocks are bit-identical to T solo detectors
     (tenant seeds follow ``config.tenant_configs``: ``seed + t``); the
-    AOT warm-start compiles against the stacked geometry.
+    AOT warm-start compiles against the stacked geometry. ``tenant_seeds``
+    overrides the per-slot detector seeds — the fleet posture
+    (``ServeParams.tenant_ids``), where slot s serves GLOBAL tenant
+    ``ids[s]`` and must carry ``seed + ids[s]``'s solo identity.
     """
     import numpy as _np
 
@@ -839,6 +867,14 @@ def prepare_chunked(
         from .utils.compile_cache import enable_persistent_cache
 
         enable_persistent_cache(cfg.compile_cache_dir)
+    if mesh is None and cfg.mesh_tenant_devices > 1:
+        # Tenant-mesh serving (ROADMAP item 1): shard the stacked chunk
+        # plane over a 2-D (tenant, partition) mesh. The detector
+        # validates tenant-axis divisibility; flags stay bit-identical
+        # at every shape (the serve parity contract over shardings).
+        mesh = make_mesh(
+            cfg.mesh_devices, tenant_devices=cfg.mesh_tenant_devices
+        )
     t0 = time.perf_counter()
     spec = ModelSpec(num_features, num_classes)
     model = build_model(cfg.model, spec, cfg)
@@ -865,6 +901,7 @@ def prepare_chunked(
         rotations=cfg.window_rotations or 1,
         validate=validate,
         tenants=cfg.tenants,
+        tenant_seeds=tenant_seeds,
     )
     build_seconds = time.perf_counter() - t0
     example = stripe_chunk(

@@ -303,6 +303,33 @@ class ServeParams(NamedTuple):
     # kill-and-resume contract.
     checkpoint: str = ""
     checkpoint_every: int = 1
+    # Fleet identity of this daemon (serve --name): stamped into every
+    # verdict record as "daemon" so a router-fronted fleet's sidecars
+    # stay attributable per backend — the join key loadgen --router uses
+    # against the router's placement journal. '' (default) = unstamped
+    # (solo daemons need no identity).
+    name: str = ""
+    # Global tenant identity per slot (serve --tenant-ids): a fleet
+    # daemon's T slots serve T *global* tenants — slot s runs global
+    # tenant ``tenant_ids[s]``'s solo identity (stream seed + stripe
+    # shuffle seed = config.tenant_configs' ``seed + id`` convention), so
+    # its flags stay bit-identical to THAT tenant's solo run wherever
+    # the router places it. ``-1`` marks a vacant spare slot (masked,
+    # receives no traffic) the router can LOADTENANT a migrating or
+    # orphaned tenant into — slot counts are compiled into the kernel
+    # (static shapes), so failover capacity is provisioned, not grown.
+    # Empty (default) = the identity mapping 0..T-1 (the PR-9 solo-host
+    # posture; nothing changes for existing daemons).
+    tenant_ids: tuple = ()
+    # Per-tenant solo-shaped checkpoints (serve --tenant-checkpoints):
+    # next to every plane checkpoint, also write one
+    # ``<checkpoint>.t<slot>`` per tenant slot via
+    # ChunkedDetector.save_tenant — the migration currency of the tenant
+    # router: when THIS daemon dies, the router re-places each orphaned
+    # tenant by LOADTENANT-ing its solo checkpoint into a surviving
+    # daemon and replaying the delta. Off by default (T extra files per
+    # checkpoint is a fleet posture, not a solo one).
+    tenant_checkpoints: bool = False
     # Idle liveness: emit a heartbeat event at least this often even with
     # no traffic, so `watch --stall-after` can tell "idle" from "dead".
     heartbeat_s: float = 10.0
@@ -427,6 +454,19 @@ class RunConfig:
     # --- distribution (reference C8, DDM_Process.py:216-226) ---
     partitions: int = 8  # reference INSTANCES: row-striped stream partitions
     mesh_devices: int = 0  # 0 = all visible devices
+    # Tenant-axis rows of a 2-D (tenant, partition) device mesh (ROADMAP
+    # item 1, fleet-scale serving): > 1 reshapes the mesh to
+    # [mesh_tenant_devices, rest] named (tenants, partitions), so the
+    # stacked [T·P, ...] tenant plane shards whole tenants across
+    # tenant-axis rows while each tenant's partitions spread along the
+    # partition axis (parallel.mesh.make_mesh(tenant_devices=...),
+    # per-leaf placement via the plane_rules regex→PartitionSpec tree).
+    # Per-tenant flags are bit-identical at EVERY mesh shape — the PR-9
+    # parity contract quantified over shardings (tested). Constraints,
+    # checked loudly: the tenant count and the device count must both
+    # split over the tenant axis. 0 (default) keeps the historical 1-D
+    # partition mesh everywhere.
+    mesh_tenant_devices: int = 0
     # Multi-tenant stream plane (api.prepare_multi / api.run_multi): run N
     # INDEPENDENT streams — each with its own detector + classifier state —
     # through ONE compiled kernel by stacking their [P, NB, B] grids on the

@@ -222,14 +222,27 @@ class ChunkedDetector:
         # (the host copy is untouched), but a caller feeding jax arrays it
         # wants to reuse must pass ``donate=False``.
         self._sharding = None
+        self._mesh = mesh
         donate_kw = {"donate_argnums": (0, 1)} if donate else {}
         if mesh is not None:
             from ..models.base import require_shardable
-            from ..parallel.mesh import partition_sharding
+            from ..parallel.mesh import TENANT_AXIS, plane_sharding
 
             require_shardable(model, mesh)
 
-            self._sharding = partition_sharding(mesh, partitions)
+            if TENANT_AXIS in mesh.axis_names:
+                # 2-D (tenant, partition) mesh (ROADMAP item 1): whole
+                # tenants land on tenant-axis rows, so the tenant count
+                # must split over that axis — a tenant straddling two
+                # rows would still be CORRECT (the flattened sharding is
+                # semantics-free) but is never what the operator meant.
+                t_rows = mesh.devices.shape[0]
+                if self.tenants % t_rows:
+                    raise ValueError(
+                        f"{self.tenants} tenant(s) do not split over the "
+                        f"{t_rows}-row tenant mesh axis"
+                    )
+            self._sharding = plane_sharding(mesh, partitions)
             self._run_chunk = jax.jit(
                 jax.vmap(run_chunk),
                 in_shardings=(self._sharding, self._sharding),
@@ -293,7 +306,7 @@ class ChunkedDetector:
         )
         init_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
         params = jax.vmap(self.model.init)(init_keys[:, 1])
-        return LoopCarry(
+        carry = LoopCarry(
             params=params,
             ddm=jax.vmap(lambda _: self._detector.init())(
                 jnp.arange(self.partitions)
@@ -304,6 +317,16 @@ class ChunkedDetector:
             retrain=jnp.ones(self.partitions, bool),
             key=init_keys[:, 0],
         )
+        if self._mesh is not None:
+            # Per-leaf placement via the regex→PartitionSpec rule tree
+            # (parallel.mesh.plane_rules): every plane-major leaf shards
+            # its leading (tenant·partition) axis over the mesh, scalars
+            # replicate — so the first donated feed starts from the
+            # layout the jitted program wants instead of resharding.
+            from ..parallel.mesh import plane_shardings
+
+            carry = jax.device_put(carry, plane_shardings(self._mesh, carry))
+        return carry
 
     def place(self, chunk: Batches) -> Batches:
         """Dispatch the host→device upload of a chunk (async, non-blocking).
@@ -715,11 +738,16 @@ class ChunkedDetector:
         lo, hi = self._tenant_span(tenant)
         return jax.tree.map(lambda x: x[lo:hi], self.carry)
 
-    def save_tenant(self, path: str, tenant: int) -> None:
+    def save_tenant(
+        self, path: str, tenant: int, extra_meta: "dict | None" = None
+    ) -> None:
         """Checkpoint ONE tenant's detector state as a solo-shaped
         checkpoint: a ``tenants=1`` detector (or a resized tenant plane)
         can :meth:`restore` / :meth:`restore_tenant` it — tenants migrate
-        between planes without dragging the other T−1 states along."""
+        between planes without dragging the other T−1 states along.
+        ``extra_meta`` rides in the JSON meta (the serve layer's
+        per-tenant stream accounting — ``serve.runner``/``serve.router``
+        ship it with the checkpoint across daemons)."""
         from ..utils.checkpoint import save_checkpoint
 
         save_checkpoint(
@@ -729,21 +757,31 @@ class ChunkedDetector:
                 "batches_done": self.batches_done,
                 "partitions": self.tenant_partitions,
                 "tenant": tenant,
+                **(extra_meta or {}),
             },
         )
 
-    def restore_tenant(self, path: str, tenant: int) -> dict:
+    def restore_tenant(
+        self, path: str, tenant: int, example_chunk: "Batches | None" = None
+    ) -> dict:
         """Load a solo-shaped checkpoint into tenant slot ``t`` of the
         stacked carry (the inverse of :meth:`save_tenant`); the other
         tenants' states are untouched. The detector must already hold a
-        carry (fed or restored) — slot surgery needs the plane to exist.
+        carry (fed or restored) — slot surgery needs the plane to exist —
+        OR be given ``example_chunk`` (any chunk of the serving shapes)
+        to build a fresh plane first: the live-migration landing path,
+        where a replacement daemon's first state IS the shipped tenant.
         ``batches_done`` stays the plane's own (all tenants advance in
         lock-step through the shared grid)."""
         from ..utils.checkpoint import load_checkpoint
 
+        if self.carry is None and example_chunk is not None:
+            self.carry = self._init_carry(
+                jax.tree.map(jnp.asarray, example_chunk)
+            )
         assert self.carry is not None, (
             "restore_tenant needs an existing carry (feed or restore the "
-            "plane first)"
+            "plane first, or pass example_chunk)"
         )
         lo, hi = self._tenant_span(tenant)
         template = jax.tree.map(lambda x: x[lo:hi], self.carry)

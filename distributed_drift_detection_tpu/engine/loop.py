@@ -148,6 +148,15 @@ def stack_tenants(batches_list) -> Batches:
     flags are bit-identical to the solo run (tested,
     ``tests/test_tenancy.py``). Host-side (numpy) — the stacking happens
     at stripe time, before the host→device upload.
+
+    On a 2-D ``(tenant, partition)`` device mesh (``parallel.mesh
+    .make_mesh(tenant_devices=...)``, RunConfig.mesh_tenant_devices) the
+    stacked plane's leading axis — and the compacted collect table's
+    provenance — shard tenant-major over both mesh axes
+    (``plane_sharding``): whole tenants land on tenant-axis rows because
+    this function lays the axis out tenant-major, ``q = t·P + p``.
+    Per-tenant flags stay bit-identical at every mesh shape (tested,
+    ``tests/test_fleet_serving.py``).
     """
     import numpy as np
 

@@ -25,6 +25,7 @@ tiny flag table.
 
 from __future__ import annotations
 
+import re
 from typing import NamedTuple
 
 import jax
@@ -45,33 +46,171 @@ from ..models.base import Model
 
 PARTITION_AXIS = "partitions"
 
+#: Second mesh axis of the fleet-scale tenant plane (ROADMAP item 1): a
+#: 2-D ``(tenants, partitions)`` mesh spreads the stacked ``[T·P, ...]``
+#: tenant plane over BOTH axes — whole tenants land on tenant-axis rows,
+#: each tenant's partitions spread along the partition axis. The flattened
+#: leading axis (``q = t·P + p``) shards over the flattened mesh
+#: (``PartitionSpec((TENANT_AXIS, PARTITION_AXIS))``), so the device
+#: order is tenant-major exactly like the stacked grid itself.
+TENANT_AXIS = "tenants"
 
-def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
-    """1-D mesh over the partition (data-parallel) axis.
+
+def make_mesh(
+    num_devices: int = 0, devices=None, *, tenant_devices: int = 0
+) -> Mesh:
+    """Device mesh over the partition (data-parallel) axis — optionally
+    2-D over ``(tenant, partition)``.
 
     ``num_devices = 0`` uses every visible device. Partition counts must be a
     multiple of the mesh size (the striper already produces equal-sized
     partition grids, mirroring the reference's ≤1-row imbalance tolerance).
+
+    ``tenant_devices > 1`` grows the tenant axis (ROADMAP item 1): the
+    devices reshape to ``[tenant_devices, rest]`` named
+    ``(TENANT_AXIS, PARTITION_AXIS)`` so a stacked multi-tenant plane
+    shards whole tenants across tenant-axis rows. ``0``/``1`` keeps the
+    historical 1-D partition mesh (every existing caller).
     """
     if devices is None:
         devices = jax.devices()
     if num_devices:
         devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+    devices = np.asarray(devices)
+    if tenant_devices and tenant_devices > 1:
+        if devices.size % tenant_devices:
+            raise ValueError(
+                f"{devices.size} device(s) do not split into a "
+                f"{tenant_devices}-row tenant axis"
+            )
+        return Mesh(
+            devices.reshape(tenant_devices, -1),
+            (TENANT_AXIS, PARTITION_AXIS),
+        )
+    return Mesh(devices, (PARTITION_AXIS,))
+
+
+def plane_axes(mesh: Mesh):
+    """The mesh axis name(s) the flattened ``(tenant·partition)`` leading
+    axis shards over: ``(TENANT_AXIS, PARTITION_AXIS)`` on a 2-D tenant
+    mesh (the leading array axis splits over both, tenant-major — exactly
+    the stacked grid's own layout), plain ``PARTITION_AXIS`` on the
+    historical 1-D mesh."""
+    if TENANT_AXIS in mesh.axis_names:
+        return (TENANT_AXIS, PARTITION_AXIS)
+    return PARTITION_AXIS
+
+
+def plane_sharding(mesh: Mesh, rows: int | None = None) -> NamedSharding:
+    """The canonical sharding of a plane-major array (leading axis = the
+    flattened ``tenant·partition`` stack; a solo run's plane is just its
+    ``P`` partitions).
+
+    When ``rows`` (the leading-axis width) is given, validates
+    divisibility by the mesh size — the invariant every plane-major
+    engine shares, on either mesh rank.
+    """
+    if rows is not None and rows % mesh.devices.size:
+        raise ValueError(
+            f"leading axis of {rows} row(s) not divisible by the "
+            f"{mesh.devices.size}-device mesh "
+            f"(shape {dict(zip(mesh.axis_names, mesh.devices.shape))})"
+        )
+    return NamedSharding(mesh, P(plane_axes(mesh)))
 
 
 def partition_sharding(mesh: Mesh, partitions: int | None = None) -> NamedSharding:
-    """The canonical partition-axis sharding for ``mesh``.
+    """The canonical partition-axis sharding for ``mesh`` (historical
+    name; since the tenant mesh landed this is :func:`plane_sharding` —
+    the partition axis of a solo run IS its plane)."""
+    return plane_sharding(mesh, partitions)
 
-    When ``partitions`` is given, validates divisibility by the mesh size —
-    the invariant every partition-major engine shares.
+
+def match_partition_rules(rules, tree, *, mesh: "Mesh | None" = None):
+    """Per-leaf ``regex → PartitionSpec`` resolution over a pytree (the
+    SNIPPETS.md [1] pattern, with the replication fallback of [3]).
+
+    ``rules`` is an ordered ``[(pattern, PartitionSpec), ...]``; each leaf
+    is named by its ``/``-joined key path (``params/centroids``,
+    ``ddm/p_min``, ``a_X``...) and takes the FIRST matching rule's spec
+    (``re.search`` semantics). Two fallbacks make the tree total:
+
+    * scalar leaves (``ndim == 0`` or one element) replicate (``P()``) —
+      a scalar cannot shard, and partitioning it is never what a rule
+      meant;
+    * a leaf no rule matches replicates too, *loudly is the caller's
+      choice*: pass a catch-all ``(".*", spec)`` tail to make unmatched
+      leaves impossible instead.
+
+    Returns a pytree of ``PartitionSpec`` mirroring ``tree`` — or of
+    ``NamedSharding`` when ``mesh`` is given (ready for ``device_put`` /
+    ``jit`` shardings).
     """
-    if partitions is not None and partitions % mesh.devices.size:
-        raise ValueError(
-            f"{partitions} partitions not divisible by the "
-            f"{mesh.devices.size}-device mesh"
-        )
-    return NamedSharding(mesh, P(PARTITION_AXIS))
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def name_of(path) -> str:
+        parts = []
+        for k in path:
+            for attr in ("name", "key", "idx"):
+                v = getattr(k, attr, None)
+                if v is not None:
+                    parts.append(str(v))
+                    break
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    def spec_for(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()  # never partition scalars
+        name = name_of(path)
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                return spec
+        return P()  # replication fallback
+
+    specs = jax.tree_util.tree_map_with_path(spec_for, tree)
+    if mesh is None:
+        return specs
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def plane_rules(mesh: Mesh):
+    """The default partition-rule tree for a plane-major state pytree
+    (the :class:`~..engine.loop.LoopCarry` every engine carries): every
+    named leaf family shards its leading ``tenant·partition`` axis over
+    the mesh's plane axes, with a catch-all tail so nothing silently
+    replicates. Scalars still fall back to replication inside
+    :func:`match_partition_rules`.
+
+    Today every family maps to the SAME spec — the carry is plane-major
+    by construction, so the tree currently reduces to its catch-all.
+    The named rules are the placement seam (host-replicated collect
+    scratch, tenant-replicated model side-state, …) kept so a future
+    divergence is one line here, not a new mechanism; they are not
+    evidence of per-family differences that exist now."""
+    spec = P(plane_axes(mesh))
+    return (
+        (r"params/|^params$", spec),  # model state, one block per slice
+        (r"ddm|^state", spec),  # detector state pytree
+        (r"^a_[Xyw]$", spec),  # carried batch_a planes
+        (r"^retrain$", spec),
+        (r"^key$", spec),  # per-(tenant, partition) PRNG keys
+        (r".*", spec),  # plane-major by construction: catch-all
+    )
+
+
+def plane_shardings(mesh: Mesh, tree):
+    """Per-leaf ``NamedSharding`` tree for a plane-major state pytree —
+    :func:`match_partition_rules` over :func:`plane_rules`. The carry
+    placement :class:`~..engine.chunked.ChunkedDetector` and the fleet
+    tests use; works on real arrays and on shape-struct templates."""
+    return match_partition_rules(plane_rules(mesh), tree, mesh=mesh)
 
 
 class MeshRunResult(NamedTuple):
@@ -395,7 +534,7 @@ def make_mesh_runner(
     if mesh is None:
         return jax.jit(run)
 
-    data_sharding = partition_sharding(mesh)
+    data_sharding = plane_sharding(mesh)
     replicated = NamedSharding(mesh, P())
     if packed_mode:
         in_batches = PackedIndexedBatches(
@@ -411,7 +550,7 @@ def make_mesh_runner(
     out_sharding = MeshRunResult(
         flags=FlagRows(*(data_sharding,) * len(FlagRows._fields)),
         drift_vote=replicated,  # replicated after the all-reduce
-        packed=NamedSharding(mesh, P(None, PARTITION_AXIS)),
+        packed=NamedSharding(mesh, P(None, plane_axes(mesh))),
         # The compacted table is tiny and its nonzero-compaction already
         # gathered across shards — replicate it like the vote.
         compact=replicated if compact_capacity else None,
@@ -430,7 +569,7 @@ def shard_batches(batches, keys: jax.Array, mesh: Mesh | None):
     """
     if mesh is None:
         return jax.device_put(batches), jax.device_put(keys)
-    sh = partition_sharding(mesh)
+    sh = plane_sharding(mesh)
     rep = NamedSharding(mesh, P())
     if isinstance(batches, PackedIndexedBatches):
         placed = PackedIndexedBatches(

@@ -170,11 +170,17 @@ def initialize(**kwargs) -> None:
     jax.distributed.initialize(**kwargs)
 
 
-def global_mesh() -> Mesh:
-    """1-D partition mesh over every device of every host."""
+def global_mesh(tenant_devices: int = 0) -> Mesh:
+    """Partition mesh over every device of every host — 1-D classically,
+    2-D ``(tenants, partitions)`` when ``tenant_devices > 1`` (ROADMAP
+    item 1: the fleet-scale tenant plane spread over a pod). Device order
+    is host-major either way, so :func:`host_partition_slice` — which
+    slices the FLATTENED plane axis — works unchanged: a host's share of
+    the stacked ``[T·P, ...]`` plane is still the contiguous row range
+    its devices own."""
     from .mesh import make_mesh
 
-    return make_mesh()
+    return make_mesh(tenant_devices=tenant_devices)
 
 
 def host_partition_slice(partitions: int, mesh: Mesh) -> slice:
@@ -254,7 +260,9 @@ def shard_batches_global(
 
         return shard_batches(batches, keys, mesh)
 
-    sharded = NamedSharding(mesh, P(PARTITION_AXIS))
+    from .mesh import plane_axes
+
+    sharded = NamedSharding(mesh, P(plane_axes(mesh)))
     replicated = NamedSharding(mesh, P())
     if partitions is None:
         n_local = sum(

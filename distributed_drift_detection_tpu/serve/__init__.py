@@ -36,6 +36,11 @@ _EXPORTS = {
     "find_verdicts": ".runner",
     "read_verdicts": ".runner",
     "run_loadgen": ".loadgen",
+    # fleet layer (tenant router over N daemons)
+    "TenantRouter": ".router",
+    "BackendSpec": ".router",
+    "HashRing": ".router",
+    "plan_fleet": ".router",
     # wire protocol v2 (binary columnar frames)
     "WireError": ".wire",
     "encode_frame": ".wire",

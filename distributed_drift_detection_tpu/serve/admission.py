@@ -276,6 +276,61 @@ class MicroBatcher:
                 "buffered_rows": self._buffered,
             }
 
+    def tenant_state(self, tenant: int = 0) -> dict:
+        """The stream-position accounting of slot ``tenant`` (a solo
+        batcher has exactly slot 0) — what a migration checkpoint must
+        carry so the landing daemon's verdicts continue this one's
+        ``rows_through`` sequence without a gap."""
+        if tenant != 0:
+            raise ValueError(f"solo batcher has only tenant 0, not {tenant}")
+        with self._cv:
+            return {
+                "start_row": self.start_row,
+                "rows_admitted": self.rows_admitted,
+                "buffered": self._buffered,
+            }
+
+    def set_tenant_state(
+        self, tenant: int, start_row: int, rows_admitted: int
+    ) -> None:
+        """Install a shipped tenant's stream positions into slot
+        ``tenant`` (the LOADTENANT landing half of a migration). Refuses
+        while rows are buffered toward a seal — position surgery under a
+        live buffer would mis-stripe every buffered row."""
+        if tenant != 0:
+            raise ValueError(f"solo batcher has only tenant 0, not {tenant}")
+        with self._cv:
+            if self._buffered:
+                raise RuntimeError(
+                    f"cannot install tenant state over {self._buffered} "
+                    "buffered row(s); flush first"
+                )
+            self.start_row = int(start_row)
+            self.rows_admitted = int(rows_admitted)
+
+    def set_tenant_identity(
+        self, tenant: int, shuffle_seed: "int | None"
+    ) -> None:
+        """Install a migrated tenant's stripe identity into slot
+        ``tenant``: the slot stripes subsequent rows with the SHIPPED
+        tenant's shuffle seed, so post-migration flags continue the
+        tenant's own solo sequence bit-identically. Same empty-buffer
+        guard as :meth:`set_tenant_state` — a seed swap under buffered
+        rows would mis-stripe them."""
+        if tenant != 0:
+            raise ValueError(f"solo batcher has only tenant 0, not {tenant}")
+        with self._cv:
+            if self._buffered:
+                raise RuntimeError(
+                    f"cannot install tenant identity over {self._buffered} "
+                    "buffered row(s); flush first"
+                )
+            self.shuffle_seed = shuffle_seed
+            self._striper = ChunkStriper(
+                self.partitions, self.per_batch, self.chunk_batches,
+                shuffle_seed,
+            )
+
     def get(self, timeout: float = 0.0) -> "SealedChunk | None":
         """Next sealed chunk, sealing a lingering partial when its
         deadline passed; ``None`` on timeout. Raises a poisoned error."""
@@ -575,6 +630,63 @@ class TenantMicroBatcher:
                 "buffered_rows": sum(self._buffered),
                 "tenant_buffered_rows": list(self._buffered),
             }
+
+    def tenant_state(self, tenant: int) -> dict:
+        """Slot ``tenant``'s stream-position accounting (the migration
+        checkpoint's meta — see :meth:`MicroBatcher.tenant_state`)."""
+        if not 0 <= tenant < self.tenants:
+            raise ValueError(
+                f"tenant {tenant} out of range 0..{self.tenants - 1}"
+            )
+        with self._cv:
+            return {
+                "start_row": self.start_rows[tenant],
+                "rows_admitted": self.tenant_rows_admitted[tenant],
+                "buffered": self._buffered[tenant],
+            }
+
+    def set_tenant_state(
+        self, tenant: int, start_row: int, rows_admitted: int
+    ) -> None:
+        """Install a shipped tenant's stream positions into slot
+        ``tenant`` (LOADTENANT). The slot's own buffer must be empty —
+        the OTHER tenants' buffers are untouched and irrelevant (their
+        positions are their own)."""
+        if not 0 <= tenant < self.tenants:
+            raise ValueError(
+                f"tenant {tenant} out of range 0..{self.tenants - 1}"
+            )
+        with self._cv:
+            if self._buffered[tenant]:
+                raise RuntimeError(
+                    f"cannot install tenant {tenant} state over "
+                    f"{self._buffered[tenant]} buffered row(s); flush first"
+                )
+            self.start_rows[tenant] = int(start_row)
+            self.tenant_rows_admitted[tenant] = int(rows_admitted)
+
+    def set_tenant_identity(
+        self, tenant: int, shuffle_seed: "int | None"
+    ) -> None:
+        """Install a migrated tenant's stripe identity into slot
+        ``tenant`` (see :meth:`MicroBatcher.set_tenant_identity`): the
+        slot's striper rebuilds with the SHIPPED shuffle seed. The
+        slot's own buffer must be empty; other tenants are untouched."""
+        if not 0 <= tenant < self.tenants:
+            raise ValueError(
+                f"tenant {tenant} out of range 0..{self.tenants - 1}"
+            )
+        with self._cv:
+            if self._buffered[tenant]:
+                raise RuntimeError(
+                    f"cannot install tenant {tenant} identity over "
+                    f"{self._buffered[tenant]} buffered row(s); flush first"
+                )
+            self.shuffle_seeds[tenant] = shuffle_seed
+            self._stripers[tenant] = ChunkStriper(
+                self.partitions, self.per_batch, self.chunk_batches,
+                shuffle_seed,
+            )
 
     def get(self, timeout: float = 0.0) -> "SealedChunk | None":
         deadline = time.monotonic() + max(timeout, 0.0)

@@ -126,6 +126,7 @@ class IngressServer:
         self, host: str, port: int, admissions, batcher, on_stop,
         sampler=None, metrics=None,
         max_frame_rows: int = wire.MAX_FRAME_ROWS,
+        on_control=None,
     ):
         # One admission controller per tenant slot (the TENANT line and
         # the frame tenant field route); a solo daemon passes a
@@ -133,6 +134,10 @@ class IngressServer:
         self.admissions = list(admissions)
         self.batcher = batcher
         self.on_stop = on_stop
+        # Tenant-migration control hook (ServeRunner.request_control):
+        # SAVETENANT/LOADTENANT wire lines land here, in wire order via
+        # the work queue; None (solo embedders) rejects the lines.
+        self.on_control = on_control
         # Daemon-side head sampler (telemetry.tracing.HeadSampler) for
         # rows the client did not TRACE-stamp; None/rate-0 = off.
         self.sampler = sampler
@@ -589,6 +594,45 @@ class IngressServer:
                     conn.trace_next = self.check_trace(s)
                 except (ValueError, IndexError) as e:
                     self._reject(conn, e)
+            elif s.startswith(("SAVETENANT", "LOADTENANT")):
+                # Migration control lines (serve.router): `SAVETENANT
+                # <slot> <path>` drains slot state into a solo-shaped
+                # checkpoint, `LOADTENANT <slot> <path>` installs one.
+                # Same no-data-row-starts-with-it argument as TENANT —
+                # malformed control must reject loudly, never admit as a
+                # dirty row. Admit what accumulated first (wire order),
+                # then ride the work queue so the request lands strictly
+                # after the admissions before it; the serve loop executes
+                # it and replies OK/ERR on this connection.
+                self._admit(conn, block, marks)
+                block, marks = [], []
+                parts = s.split(maxsplit=2)
+                try:
+                    if self.on_control is None:
+                        raise ValueError(
+                            "tenant control surface not enabled on this "
+                            "daemon"
+                        )
+                    if len(parts) != 3:
+                        raise ValueError(
+                            f"{parts[0]} needs exactly "
+                            f"'{parts[0]} <slot> <path>'"
+                        )
+                    op, slot, path = (
+                        parts[0],
+                        self.check_tenant(int(parts[1])),
+                        parts[2],
+                    )
+                except (ValueError, IndexError) as e:
+                    self._reject(conn, e)
+
+                def ctrl(op=op, slot=slot, path=path, conn=conn):
+                    self.on_control(
+                        op, slot, path,
+                        lambda line: self._send(conn, line),
+                    )
+
+                self._work.put(ctrl)
             elif s == "FLUSH":
                 self._admit(conn, block, marks)
                 block, marks = [], []

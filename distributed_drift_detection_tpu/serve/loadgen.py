@@ -261,6 +261,31 @@ def adapt_attribution(
     }
 
 
+class _FleetVerdictTail:
+    """Verdict tailing over a FLEET: every ``*.verdicts.jsonl`` under
+    each given telemetry directory (one per backend daemon), discovered
+    live — a failover's landing daemon may open its sidecar mid-replay.
+    Merged per poll; per-tenant attribution joins on each record's
+    GLOBAL tenant id, so one summary covers the whole fleet (``loadgen
+    --router``)."""
+
+    def __init__(self, dirs):
+        self.dirs = list(dirs)
+        self._tails: "dict[str, _VerdictTail]" = {}
+
+    def poll(self) -> list[dict]:
+        import glob as _glob
+
+        out: list[dict] = []
+        for d in self.dirs:
+            for path in _glob.glob(os.path.join(d, "*.verdicts.jsonl")):
+                tail = self._tails.get(path)
+                if tail is None:
+                    tail = self._tails[path] = _VerdictTail(path)
+                out.extend(tail.poll())
+        return out
+
+
 class _VerdictTail:
     """Incremental verdict-sidecar reader (torn-tail tolerant: the offset
     only advances past complete lines, like ``telemetry.watch.LogTail``)."""
@@ -390,6 +415,7 @@ def _run_loadgen_tenants(
     label_lag: int = 0,
     wire_version: str = "v1",
     arrays=None,
+    fleet_dirs=None,
 ) -> dict:
     """Multi-tenant replay: the stream is dealt round-robin (blocks of
     ``interleave`` rows) across T tenant slots over ONE connection, with
@@ -401,7 +427,24 @@ def _run_loadgen_tenants(
     covers the plane, not one tenant). ``wire_version='v2'`` ships each
     dealt block as ONE binary frame carrying its tenant id (the frame
     header routes instead of a TENANT line) — identical dealing, so
-    per-tenant streams match the v1 replay row for row."""
+    per-tenant streams match the v1 replay row for row.
+
+    ``fleet_dirs`` is the router posture (``loadgen --router``): the
+    replay's TENANT ids are GLOBAL (the router rewrites them to backend
+    slots), verdict tailing merges every sidecar under each backend's
+    telemetry directory (:class:`_FleetVerdictTail`), and attribution
+    joins on each record entry's global ``id`` — a migrated tenant's
+    verdicts continue its ``rows_through`` sequence from the landing
+    daemon's sidecar, so one summary covers the whole fleet with the
+    per-tenant latency math unchanged."""
+    global_ids = fleet_dirs is not None
+
+    def _key(ent) -> int:
+        # fleet join key: the record entry's GLOBAL tenant id (== the
+        # slot index off-fleet; vacant spares carry id -1 → filtered)
+        return int(ent.get("id", ent["tenant"])) if global_ids else int(
+            ent["tenant"]
+        )
     n_rows = len(arrays[1]) if wire_version == "v2" else len(lines)
     # Deal rows into tenant streams (round-robin blocks) and build the
     # wire segments: (tenant, [row indices]) in send order.
@@ -412,13 +455,17 @@ def _run_loadgen_tenants(
         idx = list(range(base, min(base + interleave, n_rows)))
         streams[t].extend(idx)
         segments.append((t, idx))
-    tail = _VerdictTail(verdicts) if verdicts else None
+    tail = (
+        _FleetVerdictTail(fleet_dirs)
+        if fleet_dirs
+        else _VerdictTail(verdicts) if verdicts else None
+    )
     baselines = [0] * tenants
     if tail is not None:
         for rec in tail.poll():
             for ent in rec.get("tenants") or []:
-                k = int(ent["tenant"])
-                if k < tenants:
+                k = _key(ent)
+                if 0 <= k < tenants:
                     baselines[k] = max(
                         baselines[k], int(ent["rows_through"])
                     )
@@ -486,8 +533,8 @@ def _run_loadgen_tenants(
                 records.extend(fresh)
                 for rec in fresh:
                     for ent in rec.get("tenants") or []:
-                        k = int(ent["tenant"])
-                        if k < tenants:
+                        k = _key(ent)
+                        if 0 <= k < tenants:
                             covered[k] = max(
                                 covered[k], int(ent["rows_through"])
                             )
@@ -505,7 +552,7 @@ def _run_loadgen_tenants(
                 (int(e["rows_through"]), float(r["ts"]))
                 for r in records
                 for e in (r.get("tenants") or [])
-                if int(e["tenant"]) == t
+                if _key(e) == t
             ]
             if not entries or not streams[t]:
                 continue
@@ -570,6 +617,7 @@ def run_loadgen(
     wire_version: str = "v1",
     arrays=None,
     frame_rows: int = 1024,
+    fleet_dirs=None,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
@@ -585,7 +633,11 @@ def run_loadgen(
     exercised under. ``wire_version='v2'`` replays as binary columnar
     frames of ``frame_rows`` rows (``serve.wire``): ``arrays=(X, y)``
     supplies the row data (``lines`` may be None), verdict attribution
-    is unchanged (``rows_through`` keys both protocols identically)."""
+    is unchanged (``rows_through`` keys both protocols identically).
+    ``fleet_dirs`` (``--router``) replays through a router endpoint:
+    TENANT ids are GLOBAL, verdicts are tailed from EVERY sidecar under
+    each backend's telemetry directory and attribution joins on the
+    records' global tenant ids — one summary for the whole fleet."""
     if wire_version not in ("v1", "v2"):
         raise ValueError(f"wire_version must be 'v1' or 'v2', got {wire_version!r}")
     if wire_version == "v2":
@@ -609,8 +661,13 @@ def run_loadgen(
             expect_rows=expect_rows, trace_ctx=trace_ctx,
             trace_log=trace_log, label_lag=label_lag,
             wire_version=wire_version, arrays=arrays,
+            fleet_dirs=fleet_dirs,
         )
-    tail = _VerdictTail(verdicts) if verdicts else None
+    tail = (
+        _FleetVerdictTail(fleet_dirs)
+        if fleet_dirs
+        else _VerdictTail(verdicts) if verdicts else None
+    )
     baseline = 0
     if tail is not None:
         # Rows already verdicted by earlier traffic (a warm daemon):
@@ -728,11 +785,21 @@ def main(argv=None) -> None:
                     "ragged_row), repeatable; --wire v2 corrupts the same "
                     "seeded stream positions with columnar stand-ins "
                     "(NaN cells / out-of-domain labels)")
+    ap.add_argument("--router", action="store_true",
+                    help="the endpoint is a tenant ROUTER (fleet front "
+                    "daemon): --tenants deals GLOBAL tenant ids, --dir "
+                    "(repeatable, one per backend daemon) names the "
+                    "fleet's telemetry directories — every verdict "
+                    "sidecar under them is tailed and per-tenant "
+                    "rows_through attribution joins on global ids, so "
+                    "one summary JSON covers the whole fleet")
     ap.add_argument("--verdicts", default=None,
                     help="verdict sidecar path (row→verdict latency source)")
-    ap.add_argument("--dir", dest="telemetry_dir", default=None,
+    ap.add_argument("--dir", dest="telemetry_dir", action="append",
+                    default=None,
                     help="telemetry directory: resolve the newest verdict "
-                    "sidecar in it")
+                    "sidecar in it (repeatable with --router — one per "
+                    "backend daemon)")
     ap.add_argument("--timeout", type=float, default=60.0,
                     help="max seconds to wait for verdict coverage")
     ap.add_argument("--stop", action="store_true",
@@ -773,18 +840,27 @@ def main(argv=None) -> None:
         lines = format_lines(X, y)
         for spec in args.dirty:
             dirty_rows += len(apply_dirty(lines, spec))
+    dirs = list(args.telemetry_dir or [])
+    if args.router:
+        if not dirs:
+            ap.error("--router needs --dir (one per backend daemon)")
+        if args.verdicts:
+            ap.error("--router tails every sidecar under --dir; "
+                     "drop --verdicts")
+    elif len(dirs) > 1:
+        ap.error("multiple --dir needs --router (fleet verdict tailing)")
     verdicts = args.verdicts
-    if verdicts is None and args.telemetry_dir:
+    if verdicts is None and dirs and not args.router:
         from .runner import find_verdicts
 
-        verdicts = find_verdicts(args.telemetry_dir)
+        verdicts = find_verdicts(dirs[0])
         if verdicts is None:
-            ap.error(f"no verdict sidecar under {args.telemetry_dir}")
+            ap.error(f"no verdict sidecar under {dirs[0]}")
     trace_log = None
-    if args.trace_sample > 0 and args.telemetry_dir:
+    if args.trace_sample > 0 and dirs:
         from ..telemetry.events import EventLog
 
-        trace_log = EventLog.open_run(args.telemetry_dir, name="loadgen")
+        trace_log = EventLog.open_run(dirs[0], name="loadgen")
         trace_log.emit(
             "run_started",
             run_id=trace_log.run_id,
@@ -810,6 +886,7 @@ def main(argv=None) -> None:
         wire_version=args.wire,
         arrays=(X, y) if args.wire == "v2" else None,
         frame_rows=args.frame_rows,
+        fleet_dirs=dirs if args.router else None,
     )
     report.update(
         source=args.source,
@@ -818,9 +895,12 @@ def main(argv=None) -> None:
         classes=num_classes,
         dirty_rows=dirty_rows,
     )
+    if args.router:
+        report["router"] = True
+        report["fleet_dirs"] = dirs
     if args.delayed_labels:
         report["label_lag_rows"] = args.delayed_labels
-    if args.telemetry_dir:
+    if dirs:
         # Refit-latency attribution (adapt subsystem): join the daemon's
         # adaptation events against the verdict stream's publication
         # stamps. Every run log in the directory is scanned (the
@@ -833,7 +913,9 @@ def main(argv=None) -> None:
         from ..telemetry.events import SchemaError, read_events
 
         events = []
-        for p in _glob.glob(os.path.join(args.telemetry_dir, "*.jsonl")):
+        for p in (
+            q for d in dirs for q in _glob.glob(os.path.join(d, "*.jsonl"))
+        ):
             base = os.path.basename(p)
             if base == _registry.INDEX_NAME or base.endswith(
                 _registry.SIDECAR_SUFFIXES

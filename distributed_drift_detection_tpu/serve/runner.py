@@ -175,6 +175,26 @@ class ServeRunner:
         # single controller on a solo daemon (tenant 0's otherwise) so
         # existing drivers keep working.
         self.tenants = max(int(cfg.tenants), 1)
+        # Global tenant identity per slot (ServeParams.tenant_ids; the
+        # fleet posture): slot s serves global tenant tenant_ids[s] with
+        # THAT tenant's solo seed + stripe shuffle seed; -1 = vacant
+        # spare (masked, migration landing capacity). Mutable — a
+        # LOADTENANT installs the shipped tenant's identity into the
+        # landing slot.
+        if params.tenant_ids:
+            if len(params.tenant_ids) != self.tenants:
+                raise ValueError(
+                    f"{len(params.tenant_ids)} tenant_ids for "
+                    f"{self.tenants} tenant slot(s)"
+                )
+            self.tenant_ids = [int(i) for i in params.tenant_ids]
+            active = [i for i in self.tenant_ids if i >= 0]
+            if len(set(active)) != len(active):
+                raise ValueError(
+                    f"duplicate global tenant ids in {self.tenant_ids}"
+                )
+        else:
+            self.tenant_ids = list(range(self.tenants))
         self.batcher: "MicroBatcher | None" = None
         self.admission: "AdmissionController | None" = None
         self.admissions: "list[AdmissionController]" = []
@@ -203,8 +223,26 @@ class ServeRunner:
         self._rows_published = 0
         self._detections = 0
         self._last_meta: "dict | None" = None
+        # slot → (stream_row, rows_admitted) installed by a LOADTENANT
+        # and not yet covered by a publish: _last_meta still describes
+        # the PREVIOUS occupant there, so a SAVETENANT before the next
+        # publish must use the restored accounting, not the stale meta
+        # (else the shipped watermark under-claims and the router
+        # re-feeds rows the carry already saw)
+        self._restored_accounting: "dict[int, tuple[int, int]]" = {}
         self._t_start: "float | None" = None
         self.resumed_meta: "dict | None" = None
+        # Tenant-migration control surface (SAVETENANT/LOADTENANT wire
+        # lines, serve.router): requests queue here (admitter thread) and
+        # the serve LOOP executes them once everything sealed before the
+        # request has been published — carry surgery must never race a
+        # feed, and a saved slot must describe exactly the published
+        # prefix. Each entry carries the batcher's seal watermark at
+        # request time; `_example` is the zero-row chunk restore_tenant
+        # rebuilds a fresh plane from.
+        self._control: "list[dict]" = []
+        self._control_lock = threading.Lock()
+        self._example = None
         # Pipeline depth: 2 = double-buffered (chunk k+1 uploads while k
         # computes); 1 when every chunk checkpoints, so the carry on disk
         # always describes exactly the published verdict prefix.
@@ -258,22 +296,31 @@ class ServeRunner:
             params.num_features,
             params.num_classes,
             chunk_batches=params.chunk_batches,
+            # Slot s's detector seed is its GLOBAL tenant's solo seed
+            # (identity mapping unless ServeParams.tenant_ids says
+            # otherwise); a vacant spare keeps its positional seed — its
+            # state is overwritten by the LOADTENANT that fills it.
+            tenant_seeds=[
+                cfg.seed + (s if self.tenant_ids[s] < 0 else self.tenant_ids[s])
+                for s in range(self.tenants)
+            ],
         )
         self._compile_info = dict(compile_info)
+        example = stripe_chunk(
+            np.zeros((0, params.num_features), np.float32),
+            np.zeros((0,), np.int32),
+            0,
+            cfg.partitions,
+            cfg.per_batch,
+            params.chunk_batches,
+        )
+        if self.tenants > 1:
+            from ..engine.loop import stack_tenants
+
+            example = stack_tenants([example] * self.tenants)
+        self._example = example
         resume = None
         if params.checkpoint and os.path.exists(params.checkpoint):
-            example = stripe_chunk(
-                np.zeros((0, params.num_features), np.float32),
-                np.zeros((0,), np.int32),
-                0,
-                cfg.partitions,
-                cfg.per_batch,
-                params.chunk_batches,
-            )
-            if self.tenants > 1:
-                from ..engine.loop import stack_tenants
-
-                example = stack_tenants([example] * self.tenants)
             resume = self.det.restore(params.checkpoint, example_chunk=example)
             if int(resume.get("tenants", 1)) != self.tenants:
                 raise ValueError(
@@ -322,19 +369,22 @@ class ServeRunner:
                 metrics=self._metrics,
             )
         if self.tenants > 1:
-            from ..config import tenant_configs
             from .admission import TenantMicroBatcher, _TenantSlot
 
-            tcfgs = tenant_configs(replace(cfg, tenants=self.tenants))
             self.batcher = TenantMicroBatcher(
                 self.tenants,
                 cfg.partitions,
                 cfg.per_batch,
                 params.chunk_batches,
                 num_features=params.num_features,
-                # tenant t stripes with ITS solo shuffle seed (seed + t) —
-                # the bit-parity contract with t solo daemons/batch runs
-                shuffle_seeds=[host_shuffle_seed(c) for c in tcfgs],
+                # slot s stripes with its GLOBAL tenant's solo shuffle
+                # seed (seed + tenant_ids[s]; identity mapping by
+                # default) — the bit-parity contract with solo
+                # daemons/batch runs, fleet-placement-invariant
+                shuffle_seeds=[
+                    host_shuffle_seed(self._slot_identity_cfg(s))
+                    for s in range(self.tenants)
+                ],
                 linger_s=params.linger_s,
                 # Serve meta is optional, like the solo path's .get()s: a
                 # detector-plane checkpoint (ChunkedDetector.save carries
@@ -382,7 +432,10 @@ class ServeRunner:
                 cfg.partitions,
                 cfg.per_batch,
                 params.chunk_batches,
-                shuffle_seed=host_shuffle_seed(cfg),
+                # a solo daemon serving one GLOBAL fleet tenant (single-
+                # slot backend, tenant_ids=(g,)) stripes with that
+                # tenant's identity; the default is host_shuffle_seed(cfg)
+                shuffle_seed=host_shuffle_seed(self._slot_identity_cfg(0)),
                 linger_s=params.linger_s,
                 start_row=int(resume.get("stream_row", 0)) if resume else 0,
                 chunk_index=(
@@ -474,6 +527,7 @@ class ServeRunner:
                 sampler=self._sampler,
                 metrics=self._metrics,
                 max_frame_rows=params.max_frame_rows,
+                on_control=self.request_control,
             )
             self._ingress.start()
         # SLO engine + evaluator thread: the judge must not live on the
@@ -501,6 +555,8 @@ class ServeRunner:
         return {
             "serving": True,
             "tenants": self.tenants,
+            "tenant_ids": list(self.tenant_ids),
+            "name": params.name or None,
             "host": params.host,
             # both wire protocols are always live on the socket — the
             # per-connection state machine auto-detects per message
@@ -523,6 +579,186 @@ class ServeRunner:
         """Graceful drain (signal handlers and the STOP line land here).
         Thread-safe and idempotent; the serve loop performs the drain."""
         self._stop.set()
+
+    # -- tenant-migration control surface (serve.router) ---------------------
+
+    def _slot_identity_cfg(self, slot: int) -> RunConfig:
+        """The solo config of the GLOBAL tenant slot ``slot`` serves —
+        ``config.tenant_configs``' ``seed + id`` convention, so a fleet
+        daemon's slot is bit-identical to that tenant's solo run wherever
+        the router places it. A vacant spare keeps its positional
+        identity (masked until a LOADTENANT installs a real one)."""
+        from ..config import tenant_dataset
+
+        g = self.tenant_ids[slot]
+        g = slot if g < 0 else g
+        return replace(
+            self.cfg,
+            tenants=1,
+            seed=self.cfg.seed + g,
+            dataset=tenant_dataset(self.cfg.dataset, g),
+        )
+
+    def request_control(self, op: str, slot: int, path: str, reply) -> None:
+        """Queue a ``SAVETENANT``/``LOADTENANT`` request (the ingress
+        admitter thread lands here, strictly AFTER the admissions queued
+        before the control line — wire order is stream order). The serve
+        loop executes it once every chunk sealed before this moment has
+        been published, so a saved slot describes exactly the published
+        verdict prefix; ``reply`` receives the one ``OK``/``ERR`` line."""
+        with self._control_lock:
+            self._control.append(
+                {
+                    "op": op,
+                    "slot": int(slot),
+                    "path": path,
+                    "reply": reply,
+                    # Seals so far (continues across resume, like
+                    # _published): the request may run only once these
+                    # are all published.
+                    "watermark": self.batcher.chunk_index,
+                }
+            )
+
+    def _run_controls(self) -> None:
+        """Execute every queued control that has become safe (serve-loop
+        thread only; FIFO, stopping at the first not-yet-due request so
+        wire order is preserved).
+
+        Safe means: every chunk sealed before the request has been
+        *published* (the migrating tenant's rows were all sealed by the
+        router's FLUSH, so its verdicts are complete up to the shipped
+        state) and the seal queue is empty (a sealed-but-unfed chunk
+        would leave the batcher's positions ahead of the carry — an
+        inconsistent snapshot). Chunks *in flight* (fed, unpublished)
+        are consistent — the carry and the positions both include them —
+        and their verdicts still publish from this daemon afterwards.
+        The router quiesces its forwarding to this backend around a
+        migration, so both conditions drain within a poll interval; an
+        embedder driving controls under sustained traffic must quiesce
+        the same way."""
+        while self._control:
+            with self._control_lock:
+                if not self._control:
+                    return
+                ctl = self._control[0]
+                if (
+                    self._published < ctl["watermark"]
+                    or self.batcher.depth()["queued_chunks"]
+                ):
+                    return
+                self._control.pop(0)
+            line = self._handle_control(ctl["op"], ctl["slot"], ctl["path"])
+            try:
+                ctl["reply"](line)
+            except Exception:
+                pass  # requester gone; the state change stands either way
+
+    def _handle_control(self, op: str, slot: int, path: str) -> str:
+        """One SAVETENANT/LOADTENANT, pipeline already drained past the
+        watermark. Failures answer ``ERR`` and leave the daemon serving —
+        a router retrying a migration must not kill the backend."""
+        try:
+            if not 0 <= slot < self.tenants:
+                raise ValueError(
+                    f"slot {slot} out of range (daemon serves "
+                    f"{self.tenants} tenant(s))"
+                )
+            buffered = self.batcher.tenant_state(slot)["buffered"]
+            if buffered:
+                # Checked BEFORE any state moves: a slot snapshot under
+                # buffered (unsealed) rows would record rows_admitted
+                # ahead of the carry, and a load would orphan them — the
+                # router FLUSHes (and quiesces) before either op.
+                raise RuntimeError(
+                    f"slot {slot} holds {buffered} buffered row(s); "
+                    "FLUSH before SAVETENANT/LOADTENANT"
+                )
+            if op == "SAVETENANT":
+                if self.det.carry is None:
+                    raise RuntimeError(
+                        "no detector state yet (slot never saw traffic)"
+                    )
+                rows_admitted = self._save_tenant_slot(slot, path)
+                return f"OK SAVETENANT {slot} {rows_admitted}"
+            if op == "LOADTENANT":
+                meta = self.det.restore_tenant(
+                    path, slot, example_chunk=self._example
+                )
+                rows_admitted = int(meta.get("rows_admitted", 0))
+                # Identity, then positions: the landing slot stripes
+                # subsequent rows with the SHIPPED tenant's shuffle seed
+                # and answers to its global id. A checkpoint without
+                # identity meta (ChunkedDetector.save_tenant outside a
+                # fleet daemon) keeps the slot's own.
+                if "shuffle_seed" in meta:
+                    seed = meta["shuffle_seed"]
+                    self.batcher.set_tenant_identity(
+                        slot, None if seed is None else int(seed)
+                    )
+                if "tenant_id" in meta:
+                    self.tenant_ids[slot] = int(meta["tenant_id"])
+                self.batcher.set_tenant_state(
+                    slot,
+                    int(meta.get("stream_row", 0)),
+                    rows_admitted,
+                )
+                self._restored_accounting[slot] = (
+                    int(meta.get("stream_row", 0)),
+                    rows_admitted,
+                )
+                return f"OK LOADTENANT {slot} {rows_admitted}"
+            raise ValueError(f"unknown control op {op!r}")
+        except Exception as e:
+            return f"ERR {op} {slot} {type(e).__name__}: {e}"
+
+    def _save_tenant_slot(self, slot: int, path: str) -> int:
+        """Write slot ``slot`` as a solo-shaped checkpoint carrying its
+        stream accounting (the migration currency); returns the slot's
+        ``rows_admitted`` watermark.
+
+        The accounting comes from the last PUBLISHED chunk's meta, like
+        the plane checkpoint's — the carry describes exactly the
+        published prefix, and a watermark ahead of it (the batcher's
+        admitted-side counters run ahead whenever rows are sealed or in
+        flight) would make the router re-send NOTHING for the gap and
+        silently lose those rows' verdicts past the checkpoint. The
+        batcher counters are only used before the first publish (a
+        freshly-resumed daemon, where admitted == published by the
+        resume contract)."""
+        meta = self._last_meta
+        span = self.batcher.rows_per_chunk
+        restored = self._restored_accounting.get(slot)
+        if restored is not None:
+            # landed by LOADTENANT, nothing published since: _last_meta
+            # still describes the slot's PREVIOUS occupant — the shipped
+            # checkpoint's accounting is the restore's, verbatim
+            start_row, rows_admitted = restored
+        elif meta is not None:
+            if self.tenants > 1:
+                start_row = int(meta["t_start_row"][slot]) + span
+                rows_admitted = int(meta["t_rows_through"][slot])
+            else:
+                start_row = int(meta["start_row"]) + span
+                rows_admitted = int(meta["rows_through"])
+        else:
+            st = self.batcher.tenant_state(slot)
+            start_row = int(st["start_row"])
+            rows_admitted = int(st["rows_admitted"])
+        ident = self._slot_identity_cfg(slot)
+        extra = {
+            "stream_row": start_row,
+            "rows_admitted": rows_admitted,
+            # The migration currency's identity half: the landing slot
+            # must answer to this global tenant and stripe with its solo
+            # shuffle seed — placement-invariant bit-parity.
+            "tenant_id": int(self.tenant_ids[slot]),
+            "shuffle_seed": host_shuffle_seed(ident),
+        }
+        if self.params.name:
+            extra["daemon"] = self.params.name
+        self.det.save_tenant(path, slot, extra_meta=extra)
+        return rows_admitted
 
     # -- ops-plane surface (read-only; served from the ops/evaluator
     # -- threads, so everything here reads GIL-atomic scalars or takes the
@@ -606,8 +842,22 @@ class ServeRunner:
         adm = self._adm_totals()
         p50 = hist_quantile(self._lat_hist, 0.5, stage="total")
         p99 = hist_quantile(self._lat_hist, 0.99, stage="total")
+        # Per-slot stream accounting: the router's rebalance signal (a
+        # hot slot's rows_admitted grows fastest; a backlogged one shows
+        # buffered rows) and the fleet dashboard's per-tenant view.
+        tenant_detail = None
+        if batcher is not None:
+            tenant_detail = [
+                {
+                    "tenant": t,
+                    "id": self.tenant_ids[t],
+                    **batcher.tenant_state(t),
+                }
+                for t in range(self.tenants)
+            ]
         return {
             "run_id": self._log.run_id if self._log is not None else None,
+            "name": self.params.name or None,
             "pid": os.getpid(),
             "uptime_s": (
                 round(now - self._t_start, 3)
@@ -616,6 +866,7 @@ class ServeRunner:
             ),
             "draining": self._stop.is_set(),
             "tenants": self.tenants,
+            "tenant_detail": tenant_detail,
             "rows": {
                 "ingress_seen": adm["rows_seen"],
                 "admitted": (
@@ -737,6 +988,11 @@ class ServeRunner:
                             self._inflight_n = len(inflight)
                         self._save_checkpoint()
                         self._ckpt_at = self._published
+                if self._control and not inflight:
+                    # Migration controls (SAVETENANT/LOADTENANT): run
+                    # once the pipeline has published past each request's
+                    # seal watermark — never mid-feed.
+                    self._run_controls()
                 if (
                     self._log is not None
                     and time.monotonic() - last_hb >= params.heartbeat_s
@@ -787,6 +1043,9 @@ class ServeRunner:
             "v": VERDICT_VERSION,
             "kind": "verdict",
             "ts": time.time(),
+            # Fleet identity (serve --name): the join key a router-fronted
+            # fleet's sidecar readers use against the placement journal.
+            **({"daemon": self.params.name} if self.params.name else {}),
             "chunk": meta["chunk"],
             "start_row": meta["start_row"],
             "rows": meta["rows"],
@@ -797,20 +1056,33 @@ class ServeRunner:
             "detections": int(changed.sum()),
             "changes": changes,
         }
-        if self.tenants > 1:
+        if self.tenants > 1 or self.params.tenant_ids:
             # Per-tenant verdict attribution: the top-level `changes` keep
             # STACKED partition indices (tenant t's partitions are rows
             # t·P..(t+1)·P−1 of the plane); each tenant entry re-indexes
             # its own changes tenant-locally and carries its own
             # rows/rows_through accounting — the loadgen's per-tenant
-            # latency attribution key.
+            # latency attribution key. A SOLO daemon in fleet posture
+            # (--tenant-ids g) emits the one entry too — the fleet
+            # verdict tail joins on the entries' global ids, so a
+            # single-tenant backend's verdicts must carry one (its solo
+            # MicroBatcher meta lacks the t_* vectors; the whole-plane
+            # accounting IS that tenant's).
             p_per = cg.shape[0] // self.tenants
+            t_rows = meta.get("t_rows") or [meta["rows"]]
+            t_through = meta.get("t_rows_through") or [meta["rows_through"]]
+            t_start = meta.get("t_start_row") or [meta["start_row"]]
             record["tenants"] = [
                 {
                     "tenant": t,
-                    "rows": int(meta["t_rows"][t]),
-                    "rows_through": int(meta["t_rows_through"][t]),
-                    "start_row": int(meta["t_start_row"][t]),
+                    # global tenant identity (== t off-fleet): the key a
+                    # router-fronted fleet's readers join on — a migrated
+                    # tenant's verdicts continue under its OWN id in the
+                    # landing daemon's sidecar
+                    "id": int(self.tenant_ids[t]),
+                    "rows": int(t_rows[t]),
+                    "rows_through": int(t_through[t]),
+                    "start_row": int(t_start[t]),
                     "detections": int(
                         changed[t * p_per : (t + 1) * p_per].sum()
                     ),
@@ -855,6 +1127,10 @@ class ServeRunner:
         self._rows_published = int(meta["rows_through"])
         self._detections += int(changed.sum())
         self._last_meta = meta
+        # any publish postdates every applied LOADTENANT (controls run
+        # only on a drained pipeline), so its per-slot accounting now
+        # covers the landed tenants — the restore overrides expire
+        self._restored_accounting.clear()
         if self._keep is not None:
             self._keep.append(host)
         trace_ids: list = []
@@ -917,6 +1193,20 @@ class ServeRunner:
             # adaptation state (window buffers, probation champions)
             # rides next to the carry — the mid-adaptation resume contract
             self._adapt.save(self.params.checkpoint + ADAPT_STATE_SUFFIX)
+        if self.params.tenant_checkpoints:
+            # Solo-shaped per-slot checkpoints next to the plane — the
+            # migration currency (ChunkedDetector.save_tenant): a router
+            # failing this daemon over LOADTENANTs these into survivors.
+            # Atomic each, and written at the same drained-pipeline
+            # moment as the plane, so slot and plane always agree.
+            # Vacant spares (id -1) are skipped: masked state nobody can
+            # land from, pure serialization waste on the checkpoint path.
+            for t in range(self.tenants):
+                if self.tenant_ids[t] < 0:
+                    continue
+                self._save_tenant_slot(
+                    t, f"{self.params.checkpoint}.t{t}"
+                )
         save_checkpoint(
             self.params.checkpoint,
             self.det.carry,
@@ -1087,6 +1377,25 @@ def main(argv=None) -> None:
     ap.add_argument("--checkpoint", default="",
                     help="detector-state checkpoint path (enables resume)")
     ap.add_argument("--checkpoint-every", type=int, default=1)
+    ap.add_argument("--tenant-checkpoints", action="store_true",
+                    help="also write one solo-shaped <checkpoint>.t<slot> "
+                    "per tenant at every checkpoint — the router's "
+                    "failover/migration currency (needs --checkpoint)")
+    ap.add_argument("--name", default="",
+                    help="fleet identity of this daemon: stamped into "
+                    "every verdict record ('daemon') so a router-fronted "
+                    "fleet's sidecars stay attributable per backend")
+    ap.add_argument("--tenant-ids", default="",
+                    help="comma-separated GLOBAL tenant id per slot "
+                    "(len == --tenants; -1 = vacant spare for migration "
+                    "landings): slot s serves global tenant ids[s] with "
+                    "that tenant's solo seed/shuffle identity — the "
+                    "fleet placement posture ('' = identity 0..T-1)")
+    ap.add_argument("--mesh-tenants", type=int, default=0,
+                    help="tenant-axis rows of a 2-D (tenant, partition) "
+                    "device mesh: shard the stacked tenant plane over "
+                    "devices (must divide --tenants and the device "
+                    "count; 0 = single-device/1-D, the default)")
     ap.add_argument("--compile-cache-dir", default="",
                     help="persistent XLA cache (restart warm-start)")
     ap.add_argument("--no-shuffle", action="store_true",
@@ -1133,6 +1442,20 @@ def main(argv=None) -> None:
         _resolve_policies(args.on_drift, args.tenants)
     except ValueError as e:
         ap.error(str(e))
+    if args.tenant_checkpoints and not args.checkpoint:
+        ap.error("--tenant-checkpoints needs --checkpoint (the plane stem)")
+    tenant_ids: tuple = ()
+    if args.tenant_ids:
+        try:
+            tenant_ids = tuple(
+                int(s) for s in args.tenant_ids.split(",") if s.strip() != ""
+            )
+        except ValueError:
+            ap.error(f"--tenant-ids must be comma-separated integers, "
+                     f"got {args.tenant_ids!r}")
+        if len(tenant_ids) != args.tenants:
+            ap.error(f"--tenant-ids names {len(tenant_ids)} slot(s) but "
+                     f"--tenants is {args.tenants}")
 
     # CLI-driven fault arming (DDD_FAULTS, the grid harness's pattern):
     # inert unless the env var is set. The ops-smoke CI job wedges the
@@ -1156,6 +1479,7 @@ def main(argv=None) -> None:
         data_policy=args.data_policy,
         telemetry_dir=args.telemetry_dir,
         compile_cache_dir=args.compile_cache_dir,
+        mesh_tenant_devices=args.mesh_tenants,
         results_csv="",
     )
     params = ServeParams(
@@ -1168,6 +1492,9 @@ def main(argv=None) -> None:
         max_frame_rows=args.max_frame_rows,
         checkpoint=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
+        tenant_checkpoints=args.tenant_checkpoints,
+        tenant_ids=tenant_ids,
+        name=args.name,
         heartbeat_s=args.heartbeat_s,
         ops_port=args.ops_port,
         slo=tuple(args.slo) if args.slo else ServeParams._field_defaults["slo"],

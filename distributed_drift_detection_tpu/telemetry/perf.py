@@ -131,6 +131,20 @@ CELLS = (
     # suspect, never gates). The MB/s twin prints informationally.
     ("serve_ingest_rows_per_sec", _UP, True, "rows/s"),
     ("serve_ingest_mb_per_sec", _UP, False, "MB/s"),
+    # Fleet-scale serving (bench.py --fleet, r14+): aggregate rows/s of
+    # a router-fronted MULTI-PROCESS serve fleet (N subprocess daemons,
+    # consistent-hash tenant placement, v2 frames through the router's
+    # header-rewrite path, full fleet verdict coverage). GATED — the
+    # fleet tentpole's whole claim is aggregate throughput scaling with
+    # daemon count instead of plateauing at one process, and a
+    # regression is a code property of the router/fleet path. The
+    # 1-daemon baseline and the scaling ratio print informationally
+    # (both move with host load; the gate is the absolute aggregate
+    # rate). Stall-aware via the fleet_timeout/fleet_drained markers,
+    # like the serve cells.
+    ("fleet_agg_rows_per_sec", _UP, True, "rows/s"),
+    ("fleet_agg_rows_per_sec_d1", _UP, False, "rows/s"),
+    ("fleet_speedup", _UP, False, "x"),
     # Adaptation recovery (bench.py --serve adapt rider, r12+): rows from
     # a drift verdict until post-drift chunk error returns within the
     # policy's epsilon of the pre-drift level, on the planted
@@ -414,6 +428,9 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "serve_registry_p99_ms",
         "serve_ingest_rows_per_sec",
         "serve_ingest_mb_per_sec",
+        "fleet_agg_rows_per_sec",
+        "fleet_agg_rows_per_sec_d1",
+        "fleet_speedup",
         "serve_adapt_recovery_rows",
         "mean_delay_batches",
         "detections",
@@ -474,7 +491,8 @@ def diff_benches(
     caller gates on ``[r for r in regressions if not r.suspect]``.
     """
     rows = []
-    cell_maps, all_notes, contended, serve_suspect = [], [], [], []
+    cell_maps, all_notes, contended = [], [], []
+    serve_suspect, fleet_suspect = [], []
     for name, bench, notes in named:
         cells, derived = bench_cells(bench)
         cell_maps.append(cells)
@@ -485,6 +503,10 @@ def diff_benches(
         serve_suspect.append(
             bool(bench.get("serve_timeout"))
             or bench.get("serve_drained") is False
+        )
+        fleet_suspect.append(
+            bool(bench.get("fleet_timeout"))
+            or bench.get("fleet_drained") is False
         )
         all_notes.extend(f"{name}: {n}" for n in notes + derived)
 
@@ -526,6 +548,10 @@ def diff_benches(
                 if cell.startswith("serve_"):
                     suspect = (
                         suspect or serve_suspect[i - 1] or serve_suspect[i]
+                    )
+                if cell.startswith("fleet_"):
+                    suspect = (
+                        suspect or fleet_suspect[i - 1] or fleet_suspect[i]
                     )
                 regressions.append(
                     Regression(

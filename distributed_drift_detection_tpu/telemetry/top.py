@@ -140,9 +140,25 @@ class StatuszSource:
             wire = f"v1:{ingress.get('frames_v1', 0)} v2:{ingress.get('frames_v2', 0)}"
             if ingress.get("decode_errors"):
                 wire += f" err:{ingress['decode_errors']}"
+        status = "draining" if s.get("draining") else "live"
+        if s.get("router"):
+            # A tenant router's /statusz (serve.router): the row reads
+            # like a daemon serving the whole fleet, with the fleet
+            # health riding the WIRE column — backends alive, graceful
+            # migrations, failovers, rows lost past replay buffers.
+            status = "router" if not s.get("draining") else "draining"
+            backs = s.get("backends") or []
+            alive = sum(1 for b in backs if b.get("alive"))
+            fleet = (
+                f"be:{alive}/{len(backs)} mig:{s.get('migrations', 0)} "
+                f"fo:{s.get('failovers', 0)}"
+            )
+            if s.get("rows_lost"):
+                fleet += f" lost:{s['rows_lost']}"
+            wire = f"{wire} {fleet}" if wire else fleet
         return {
             "run": s.get("run_id") or self.url,
-            "status": "draining" if s.get("draining") else "live",
+            "status": status,
             "rows": rows,
             "rows_per_sec": rate,
             "p50_ms": lat.get("p50"),

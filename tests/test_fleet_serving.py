@@ -1,0 +1,832 @@
+"""Fleet-scale serving (ISSUE 14): the mesh-sharded tenant plane and the
+tenant router.
+
+Tentpole (a) acceptance: per-tenant drift flags are **bit-identical** to
+solo runs under every tested tenant-mesh shape — the PR-9 parity
+contract quantified over shardings (`RunConfig.mesh_tenant_devices`,
+`parallel.mesh.make_mesh(tenant_devices=...)`, the regex→PartitionSpec
+`match_partition_rules` tree).
+
+Tentpole (b) acceptance: a router-fronted fleet of N daemons serves
+global tenants with flags and verdict sidecar records bit-identical to
+solo runs, ACROSS a live migration (drain → ship checkpoint → resume on
+another daemon) — and no verdict is lost past the shipped checkpoint.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_drift_detection_tpu import RunConfig, run_multi
+from distributed_drift_detection_tpu.config import (
+    ServeParams,
+    replace,
+    tenant_configs,
+)
+from distributed_drift_detection_tpu.engine.chunked import ChunkedDetector
+from distributed_drift_detection_tpu.engine.loop import stack_tenants
+from distributed_drift_detection_tpu.io import planted_prototypes
+from distributed_drift_detection_tpu.io.stream import stripe_chunk
+from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+from distributed_drift_detection_tpu.models import ModelSpec, build_model
+from distributed_drift_detection_tpu.parallel.mesh import (
+    PARTITION_AXIS,
+    TENANT_AXIS,
+    make_mesh,
+    match_partition_rules,
+    plane_axes,
+    plane_sharding,
+    plane_shardings,
+    split_tenant_flags,
+)
+from distributed_drift_detection_tpu.serve import (
+    BackendSpec,
+    HashRing,
+    ServeRunner,
+    TenantRouter,
+    plan_fleet,
+    read_verdicts,
+)
+from distributed_drift_detection_tpu.serve.loadgen import (
+    format_lines,
+    run_loadgen,
+)
+from distributed_drift_detection_tpu.serve.router import (
+    plan_rebalance,
+)
+
+from jax.sharding import PartitionSpec as P
+
+
+def _assert_flags_equal(a, b, msg=""):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=f"{msg} {name}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# tentpole (a): the 2-D (tenant, partition) mesh
+# ---------------------------------------------------------------------------
+
+
+def test_make_mesh_tenant_axis_shapes():
+    """make_mesh grows a (tenants, partitions) axis pair; 0/1 keeps the
+    historical 1-D mesh; a non-dividing row count is a loud error."""
+    m1 = make_mesh()
+    assert m1.axis_names == (PARTITION_AXIS,)
+    assert plane_axes(m1) == PARTITION_AXIS
+    m2 = make_mesh(tenant_devices=2)
+    assert m2.axis_names == (TENANT_AXIS, PARTITION_AXIS)
+    assert m2.devices.shape[0] == 2
+    assert plane_axes(m2) == (TENANT_AXIS, PARTITION_AXIS)
+    assert make_mesh(tenant_devices=1).axis_names == (PARTITION_AXIS,)
+    with pytest.raises(ValueError, match="tenant axis"):
+        make_mesh(tenant_devices=3)  # 8 CPU devices don't split by 3
+
+
+def test_plane_sharding_divisibility():
+    mesh = make_mesh(tenant_devices=2)
+    sh = plane_sharding(mesh, mesh.devices.size * 2)
+    assert sh.spec == P((TENANT_AXIS, PARTITION_AXIS))
+    with pytest.raises(ValueError, match="not divisible"):
+        plane_sharding(mesh, mesh.devices.size + 1)
+
+
+def test_match_partition_rules_tree():
+    """The SNIPPETS.md [1] pattern: per-leaf regex → PartitionSpec with
+    scalar and unmatched-leaf replication fallbacks; ordered first-match
+    wins; mesh= returns NamedSharding leaves."""
+    mesh = make_mesh(tenant_devices=2)
+    spec = P(plane_axes(mesh))
+    tree = {
+        "params": {"centroids": np.zeros((8, 3, 5))},
+        "count": np.zeros(()),  # scalar → replicate, rules ignored
+        "odd_leaf": np.zeros((8, 2)),  # no rule → replicate
+    }
+    rules = ((r"params/", spec),)
+    specs = match_partition_rules(rules, tree)
+    assert specs["params"]["centroids"] == spec
+    assert specs["count"] == P()
+    assert specs["odd_leaf"] == P()  # replication fallback
+    # catch-all tail makes unmatched leaves impossible
+    specs = match_partition_rules(rules + ((r".*", spec),), tree)
+    assert specs["odd_leaf"] == spec
+    assert specs["count"] == P()  # scalars still replicate
+    # ordered: first match wins over the catch-all
+    specs = match_partition_rules(
+        ((r"centroids", P()),) + ((r".*", spec),), tree
+    )
+    assert specs["params"]["centroids"] == P()
+    # mesh= resolves to NamedSharding, ready for device_put
+    sharded = match_partition_rules(rules, tree, mesh=mesh)
+    assert sharded["params"]["centroids"].spec == spec
+    assert sharded["params"]["centroids"].mesh.shape_tuple == (
+        mesh.shape_tuple
+    )
+
+
+@pytest.mark.parametrize("tenant_devices", [2, 4])
+def test_one_shot_mesh_shape_parity(tenant_devices):
+    """The tentpole-(a) acceptance: run_multi flags bit-identical at
+    every tenant-mesh shape (vs the historical 1-D mesh)."""
+    base = dict(
+        dataset="synth:rialto,seed=3,rows_per_class=160",
+        partitions=4, per_batch=50, model="centroid", results_csv="",
+        tenants=4,
+    )
+    ref = run_multi(RunConfig(**base))
+    got = run_multi(RunConfig(**base, mesh_tenant_devices=tenant_devices))
+    for t in range(4):
+        _assert_flags_equal(
+            got.results[t].flags, ref.results[t].flags,
+            f"td={tenant_devices} tenant={t}",
+        )
+        np.testing.assert_array_equal(
+            got.results[t].drift_vote, ref.results[t].drift_vote
+        )
+
+
+def test_one_shot_mesh_constraint_errors():
+    base = dict(
+        dataset="synth:rialto,seed=3,rows_per_class=160",
+        partitions=4, per_batch=50, model="centroid", results_csv="",
+        tenants=3,
+    )
+    with pytest.raises(ValueError, match="tenant"):
+        run_multi(RunConfig(**base, mesh_tenant_devices=2))  # 3 % 2
+
+
+def test_chunked_tenant_mesh_parity():
+    """ChunkedDetector on a 2-D tenant mesh: per-chunk flags
+    bit-identical to the unmeshed stacked plane, and the carry's leaves
+    actually land on the plane sharding (per-leaf rules applied)."""
+    P_, B, CB, T, F = 2, 50, 2, 4, 27
+    span = P_ * B * CB
+
+    def chunks_for(seed):
+        X, y = rialto_like_xy(seed=seed, rows_per_class=3 * span // 10)
+        return [
+            stripe_chunk(
+                X[k * span : (k + 1) * span],
+                y[k * span : (k + 1) * span],
+                k * span, P_, B, CB, shuffle_seed=seed + 0x5EED,
+            )
+            for k in range(3)
+        ]
+
+    model = build_model("centroid", ModelSpec(F, 10))
+    per_tenant = [chunks_for(100 + t) for t in range(T)]
+    stacked = [
+        stack_tenants([per_tenant[t][k] for t in range(T)])
+        for k in range(3)
+    ]
+    ref = ChunkedDetector(model, partitions=P_, seed=7, tenants=T)
+    mesh = make_mesh(tenant_devices=2)
+    det = ChunkedDetector(
+        model, partitions=P_, seed=7, tenants=T, mesh=mesh
+    )
+    for k, c in enumerate(stacked):
+        got = det.feed(c)
+        want = ref.feed(c)
+        _assert_flags_equal(
+            jax.tree.map(np.asarray, got),
+            jax.tree.map(np.asarray, want),
+            f"chunk {k}",
+        )
+    # the carry is sharded by the rule tree, not accidentally replicated
+    shardings = plane_shardings(mesh, det.carry)
+    leaf = det.carry.params
+    got_sh = jax.tree.leaves(jax.tree.map(lambda x: x.sharding, leaf))[0]
+    want_sh = jax.tree.leaves(shardings.params)[0]
+    assert got_sh.spec == want_sh.spec
+
+
+def test_chunked_tenant_mesh_constraint():
+    model = build_model("centroid", ModelSpec(5, 4))
+    mesh = make_mesh(tenant_devices=2)
+    with pytest.raises(ValueError, match="tenant"):
+        ChunkedDetector(model, partitions=4, seed=0, tenants=3, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# tentpole (b) units: placement, rebalance planning, replay slicing
+# ---------------------------------------------------------------------------
+
+
+def test_hashring_stable_under_exclusion():
+    """Excluding a dead backend moves ONLY its keys — everyone else's
+    placement is untouched (the consistent-hashing contract)."""
+    ring = HashRing(["a", "b", "c"])
+    before = {g: ring.place(g) for g in range(64)}
+    after = {g: ring.place(g, exclude=["b"]) for g in range(64)}
+    assert all(v in ("a", "c") for v in after.values())
+    for g in range(64):
+        if before[g] != "b":
+            assert after[g] == before[g], f"tenant {g} moved needlessly"
+    moved = [g for g in range(64) if before[g] == "b"]
+    assert moved  # 64 keys over 3 backends: b owns some
+    with pytest.raises(RuntimeError, match="no live backend"):
+        ring.place(0, exclude=["a", "b", "c"])
+    with pytest.raises(ValueError, match="duplicate"):
+        HashRing(["a", "a"])
+
+
+def test_plan_fleet_covers_all_tenants_with_spares():
+    assign = plan_fleet(16, ["b0", "b1", "b2"], spares=2)
+    placed = sorted(
+        g for ids in assign.values() for g in ids if g >= 0
+    )
+    assert placed == list(range(16))
+    for ids in assign.values():
+        assert ids.count(-1) >= 1  # landing capacity everywhere
+        assert len(ids) >= 1
+
+
+def test_plan_rebalance():
+    # imbalanced: hottest tenant moves hot → cold
+    move = plan_rebalance(
+        {"a": 1000.0, "b": 10.0},
+        {"a": {0: 800.0, 1: 200.0}, "b": {2: 10.0}},
+        {"a": 0, "b": 1},
+        ratio=2.0,
+    )
+    assert move == (0, "a", "b")
+    # a cold fleet never rebalances
+    assert plan_rebalance(
+        {"a": 30.0, "b": 20.0},
+        {"a": {0: 20.0, 1: 10.0}, "b": {2: 20.0}},
+        {"a": 1, "b": 1},
+    ) is None
+    # moving the only tenant just moves the imbalance
+    assert plan_rebalance(
+        {"a": 1000.0, "b": 10.0},
+        {"a": {0: 1000.0}, "b": {2: 10.0}},
+        {"a": 1, "b": 1},
+    ) is None
+    # no vacancy on the cold side
+    assert plan_rebalance(
+        {"a": 1000.0, "b": 10.0},
+        {"a": {0: 800.0, 1: 200.0}, "b": {2: 10.0}},
+        {"a": 1, "b": 0},
+    ) is None
+
+
+def test_top_renders_router_status():
+    """The `top` dashboard reads a router's /statusz like a daemon row:
+    status 'router', fleet health (backends alive, migrations,
+    failovers, rows lost) riding the WIRE column."""
+    from distributed_drift_detection_tpu.telemetry import top as top_mod
+
+    status = {
+        "router": True,
+        "run_id": "router",
+        "uptime_s": 5.0,
+        "draining": False,
+        "rows": {"published": 1000, "admitted": 1000},
+        "detections": None,
+        "ingress": {"frames_v1": 3, "frames_v2": 7, "decode_errors": 0},
+        "migrations": 1,
+        "failovers": 2,
+        "rows_lost": 9,
+        "alerts": [{"rule": "backend_dead:b1"}],
+        "backends": [
+            {"name": "b0", "alive": True},
+            {"name": "b1", "alive": False},
+        ],
+        "placements": {},
+    }
+    import io as _io
+    import json as _json
+    from unittest import mock
+
+    src = top_mod.StatuszSource("http://127.0.0.1:1/statusz")
+
+    class _Resp(_io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    with mock.patch.object(
+        top_mod.urllib.request,
+        "urlopen",
+        return_value=_Resp(_json.dumps(status).encode()),
+    ):
+        row = src.poll(0.0)
+    assert row["status"] == "router"
+    assert "be:1/2" in row["wire"]
+    assert "mig:1" in row["wire"] and "fo:2" in row["wire"]
+    assert "lost:9" in row["wire"]
+    assert row["alerts"] == ["backend_dead:b1"]
+    frame = top_mod.render([row], 0.0)
+    assert "be:1/2" in frame
+
+
+def test_backend_spec_parse():
+    spec = BackendSpec("10.0.0.1:7007:7008")
+    assert (spec.host, spec.port, spec.ops_port) == ("10.0.0.1", 7007, 7008)
+    with pytest.raises(ValueError, match="host:port:ops_port"):
+        BackendSpec("10.0.0.1:7007")
+
+
+def test_slice_entry_drops_covered_rows():
+    """The failover re-send drops rows the checkpoint already covers —
+    v1 keeps a TRACE stamp only with its surviving row; v2 re-encodes
+    the frame tail."""
+    from distributed_drift_detection_tpu.serve import wire
+
+    entry = (
+        "v1",
+        ["TRACE t0 s0", "1.0,2.0,0", "1.5,2.5,1", "TRACE t2 s2",
+         "2.0,3.0,0"],
+        3,
+    )
+    kind, payload, rows = TenantRouter._slice_entry(entry, 2)
+    assert (kind, rows) == ("v1", 1)
+    assert payload == ["TRACE t2 s2", "2.0,3.0,0"]
+
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    y = np.arange(4, dtype=np.int32)
+    frame = wire.encode_frame(X, y, tenant=5)
+    kind, payload, rows = TenantRouter._slice_entry(("v2", frame, 4), 1)
+    assert (kind, rows) == ("v2", 3)
+    header, X2, y2, _ = wire.decode_frame(payload)
+    np.testing.assert_array_equal(np.asarray(X2), X[1:])
+    np.testing.assert_array_equal(np.asarray(y2), y[1:])
+
+
+def _stub_router():
+    """A TenantRouter wired to two stub backends without start(): src
+    serves global tenant 0 in slot 0, dst is full (no vacancy)."""
+    from collections import deque
+
+    r = TenantRouter(
+        [BackendSpec("127.0.0.1:1:2"), BackendSpec("127.0.0.1:3:4")]
+    )
+    src, dst = r.backends
+    src.name, dst.name = "src", "dst"
+    src.slot_ids, dst.slot_ids = [0], [7]
+    r._by_name = {"src": src, "dst": dst}
+    r.ring = HashRing(["src", "dst"])
+    r.place[0] = (src, 0)
+    r._state[0] = "active"
+    r._buffer[0] = deque()
+    r._buffered_rows[0] = 0
+    r._pending[0] = []
+    r._pending_rows[0] = 0
+    r.rows_forwarded[0] = 0
+    return r, src, dst
+
+
+def test_migrate_failure_resumes_at_source():
+    """A migration that cannot land (destination has no vacant slot)
+    must RESUME the tenant at its still-live source — never leave it
+    orphaned with its rows held forever (the source still has the state;
+    SAVETENANT is non-destructive)."""
+    r, src, dst = _stub_router()
+    sent = []
+    src.send = lambda payload: sent.append(payload)
+    src.control = lambda line, timeout=120.0: f"OK {line.split()[0]} done"
+    src.statusz = lambda timeout=5.0: {
+        "tenant_detail": [
+            {"id": 0, "rows_admitted": 0, "buffered": 0}
+        ]
+    }
+    assert r.migrate_tenant(0, "dst", drain_timeout=0.5) is False
+    assert r._state[0] == "active"
+    assert r.place[0] == (src, 0)
+    assert src.slot_ids == [0] and dst.slot_ids == [7]
+    # a held row dispatched mid-quiesce flushed on the resume
+    assert r._pending[0] == [] and r._pending_rows[0] == 0
+
+
+def test_orphaned_pending_is_capped():
+    """An orphaned tenant's held rows are bounded like the replay
+    buffer — dropped rows count LOUDLY in rows_lost, never OOM the
+    router."""
+    r, src, _ = _stub_router()
+    r.replay_rows = 8
+    r._state[0] = "orphaned"
+    for i in range(5):
+        r._dispatch(0, ("v1", [f"{i},0"] * 4, 4))
+    assert r._pending_rows[0] == 8
+    assert len(r._pending[0]) == 2
+    assert r.rows_lost == 12
+    assert 0 in r._pending_overflowed
+
+
+def test_rebalance_survives_migration_race(monkeypatch):
+    """A rebalance plan that races a failover/quiesce (migrate_tenant
+    raises) must skip the round, not kill the rebalance thread."""
+    from distributed_drift_detection_tpu.serve import router as router_mod
+
+    r, src, dst = _stub_router()
+    monkeypatch.setattr(
+        router_mod, "plan_rebalance", lambda *a: (0, "src", "dst")
+    )
+
+    def _boom(g, dst_name, **kw):
+        raise RuntimeError("tenant 0 is quiesced; cannot migrate")
+
+    monkeypatch.setattr(r, "migrate_tenant", _boom)
+    import urllib.error
+
+    for b in (src, dst):
+        b.statusz = lambda timeout=5.0: (_ for _ in ()).throw(
+            urllib.error.URLError("down")
+        )
+    assert r.rebalance_once() is None
+
+
+# ---------------------------------------------------------------------------
+# the fleet end to end: router parity + live migration
+# ---------------------------------------------------------------------------
+
+SPAN = 4 * 25 * 2  # partitions * per_batch * chunk_batches
+
+
+def _cfg(tele=None, tenants=2, **kw):
+    kw.setdefault("seed", 5)
+    return RunConfig(
+        partitions=4, per_batch=25, model="centroid",
+        shuffle_batches=True, results_csv="", window=1,
+        data_policy="quarantine", telemetry_dir=tele, tenants=tenants,
+        **kw,
+    )
+
+
+def _params(stream, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("ops_port", 0)
+    kw.setdefault("chunk_batches", 2)
+    kw.setdefault("linger_s", 0.05)
+    return ServeParams(
+        num_features=stream.num_features,
+        num_classes=stream.num_classes,
+        **kw,
+    )
+
+
+def _start(runner):
+    banner = runner.start()
+    t = threading.Thread(target=runner.serve_forever, daemon=True)
+    t.start()
+    return banner, t
+
+
+def _tenant_records(paths, gid):
+    """Per-tenant verdict entries for global tenant ``gid`` across a
+    fleet's sidecars, in rows_through order: the placement-invariant
+    parity surface (positions and changes are stream-global)."""
+    out = []
+    for p in paths:
+        if not p or not os.path.exists(p):
+            continue
+        for rec in read_verdicts(p):
+            for ent in rec.get("tenants") or []:
+                if int(ent.get("id", ent["tenant"])) == gid and ent["rows"]:
+                    out.append(ent)
+    out.sort(key=lambda e: int(e["rows_through"]))
+    return out
+
+
+def _assert_tenant_records_equal(got, ref, msg=""):
+    assert len(got) == len(ref), (
+        f"{msg}: {len(got)} vs {len(ref)} per-tenant verdict entries"
+    )
+    for i, (g, r) in enumerate(zip(got, ref)):
+        for k in ("rows", "rows_through", "start_row", "detections"):
+            assert int(g[k]) == int(r[k]), f"{msg} entry {i} {k}"
+        assert [tuple(c) for c in g["changes"]] == [
+            tuple(c) for c in r["changes"]
+        ], f"{msg} entry {i} changes"
+
+
+@pytest.mark.parametrize("wire_version", ["v1", "v2"])
+def test_fleet_router_replay_parity(wire_version, tmp_path, monkeypatch):
+    """2 backends + router on loopback, a dealt 2-tenant loadgen replay
+    through the ROUTER endpoint (`--router` posture: global ids, fleet
+    verdict tailing): full coverage, per-tenant latency attribution, and
+    per-tenant flags bit-identical to each tenant's SOLO daemon fed the
+    same dealt sub-stream. Both wire protocols cross the router — v2
+    exercises the header-only frame relay (a payload view over the live
+    buffer would be a BufferError on the resize; pinned here)."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(5, concepts=3, rows_per_concept=220,
+                                features=6)
+    lines = format_lines(stream.X, stream.y)
+
+    backends, threads = [], []
+    for name, gid in (("A", 0), ("B", 1)):
+        # A fleet backend with a vacant spare can never full-seal (the
+        # spare never spans), so a short linger would seal at arbitrary
+        # timing-dependent boundaries and break bit-parity with the
+        # solo reference. A long linger pins every seal to the wire's
+        # FLUSH/STOP drain — span-aligned, deterministic.
+        r = ServeRunner(
+            _cfg(f"tele{name}", tenants=2),
+            _params(stream, tenant_ids=(gid, -1), name=name,
+                    linger_s=30.0),
+            keep_flags=True,
+        )
+        banner, t = _start(r)
+        backends.append((r, banner))
+        threads.append(t)
+    router = TenantRouter(
+        [
+            BackendSpec(f"127.0.0.1:{b['port']}:{b['ops_port']}")
+            for _, b in backends
+        ],
+        telemetry_dir=str(tmp_path / "teleR"),
+        ops_port=0,
+    )
+    banner = router.start()
+    assert banner["tenants"] == [0, 1]
+
+    X = np.ascontiguousarray(stream.X, np.float32)
+    y = np.ascontiguousarray(stream.y, np.int32)
+    rep = run_loadgen(
+        banner["host"], banner["port"],
+        lines if wire_version == "v1" else None,
+        rate=0.0, timeout=180, stop=True, tenants=2,
+        wire_version=wire_version,
+        arrays=(X, y) if wire_version == "v2" else None,
+        frame_rows=64,
+        fleet_dirs=["teleA", "teleB"],
+    )
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive()
+    router.stop()
+    assert not rep["timeout"]
+    assert rep["rows_covered"] == len(lines)
+    assert rep["tenant_rows_covered"] == rep["tenant_rows_sent"]
+    assert rep["p99_ms"] is not None
+
+    # per-tenant flag parity vs each tenant's solo run on its dealt
+    # sub-stream (the loadgen dealing: round-robin blocks of 64)
+    streams = [[], []]
+    for base in range(0, len(lines), 64):
+        streams[(base // 64) % 2].extend(
+            range(base, min(base + 64, len(lines)))
+        )
+    any_detections = False
+    for (r, _), gid in zip(backends, (0, 1)):
+        sub = [lines[i] for i in streams[gid]]
+        solo = ServeRunner(
+            tenant_configs(_cfg(tenants=2))[gid],
+            _params(stream, port=None, ops_port=None),
+            keep_flags=True,
+        )
+        solo.start()
+        solo.admission.admit_lines(sub)
+        solo.batcher.flush()
+        solo.request_stop()
+        assert solo.serve_forever() == 0
+        got = split_tenant_flags(r.flags(), 2)[0]  # slot 0 serves gid
+        ref = solo.flags()
+        _assert_flags_equal(got, ref, f"tenant {gid}")
+        any_detections = any_detections or (
+            np.asarray(ref.change_global) >= 0
+        ).any()
+    assert any_detections
+
+
+def test_live_migration_bit_parity(tmp_path, monkeypatch):
+    """The migration acceptance: drain → ship checkpoint → resume on a
+    second in-process daemon. The migrated tenant's drift flags and
+    verdict sidecar records are bit-identical to an unmigrated solo run,
+    no verdict is lost past the shipped checkpoint, and the OTHER tenant
+    keeps serving throughout."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(7, concepts=3, rows_per_concept=300,
+                                features=6)
+    lines = format_lines(stream.X, stream.y)
+    half = 2 * SPAN  # migrate at a chunk boundary's worth of rows
+    subs = [lines[0::2], lines[1::2]]  # dealt: even rows → 0, odd → 1
+
+    backends = {}
+    threads = []
+    for name, gid in (("A", 0), ("B", 1)):
+        r = ServeRunner(
+            _cfg(f"tele{name}", tenants=2, seed=7),
+            _params(
+                stream,
+                tenant_ids=(gid, -1),
+                name=name,
+                checkpoint=str(tmp_path / f"{name}.ckpt"),
+                tenant_checkpoints=True,
+                # seal ONLY at the wire's FLUSH points (see the parity
+                # test): deterministic span-aligned boundaries
+                linger_s=30.0,
+            ),
+            keep_flags=True,
+        )
+        banner, t = _start(r)
+        backends[name] = (r, banner)
+        threads.append(t)
+    router = TenantRouter(
+        [
+            BackendSpec(f"127.0.0.1:{b['port']}:{b['ops_port']}")
+            for _, b in backends.values()
+        ],
+        telemetry_dir=str(tmp_path / "teleR"),
+    )
+    router.start()
+
+    def send(sock, gid, block):
+        sock.sendall(
+            (f"TENANT {gid}\n" + "\n".join(block) + "\n").encode()
+        )
+
+    with socket.create_connection(
+        ("127.0.0.1", router.port), timeout=30
+    ) as sock:
+        # phase 1: both tenants, then FLUSH so everything seals
+        send(sock, 0, subs[0][:half])
+        send(sock, 1, subs[1][:half])
+        sock.sendall(b"FLUSH\n")
+        # the router forwards asynchronously — pin the migration point
+        # to the phase boundary (all phase-1 rows forwarded) so the
+        # checkpoint ships exactly `half` rows and the bit-parity
+        # reference's FLUSH pattern matches
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with router._lock:
+                fwd = router.rows_forwarded[0]
+            if fwd == half:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("router never forwarded phase 1")
+        # live migration: tenant 0 moves A → B mid-replay
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if router.migrate_tenant(0, "B"):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("migration never succeeded")
+        assert router.place[0][0].name == "B"
+        # phase 2: tenant 0's remaining rows land on B; tenant 1 kept
+        # serving on B throughout
+        send(sock, 0, subs[0][half:])
+        send(sock, 1, subs[1][half:])
+        sock.sendall(b"FLUSH\nSTOP\n")
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive()
+    status = router.status()
+    router.stop()
+    assert status["migrations"] == 1
+    assert status["rows_lost"] == 0
+
+    # the unmigrated reference: ONE 2-tenant daemon (identity placement
+    # — global tenant g in slot g, the same seed/shuffle identities the
+    # fleet's slots carry) fed both substreams at the same FLUSH points.
+    solo = ServeRunner(
+        _cfg("teleSolo", tenants=2, seed=7),
+        _params(stream, port=None, ops_port=None),
+        keep_flags=True,
+    )
+    solo.start()
+    for t in range(2):
+        solo.admissions[t].admit_lines(subs[t][:half])
+    solo.batcher.flush()
+    for t in range(2):
+        solo.admissions[t].admit_lines(subs[t][half:])
+    solo.batcher.flush()
+    solo.request_stop()
+    assert solo.serve_forever() == 0
+
+    # drift flags + verdict records: the flags a served tenant publishes
+    # ARE its verdict entries' change tuples (partition, batch, global
+    # position) — the placement-invariant surface. Tenant 0's entries
+    # across BOTH daemons' sidecars must equal the unmigrated solo
+    # run's, in rows_through order, with no gap past the shipped
+    # checkpoint.
+    rA, _ = backends["A"]
+    rB, _ = backends["B"]
+    assert rB.tenant_ids.index(0) == 1  # landed in B's spare slot
+    got_recs = _tenant_records(
+        [rA.verdicts_path, rB.verdicts_path], 0
+    )
+    ref_recs = _tenant_records([solo.verdicts_path], 0)
+    _assert_tenant_records_equal(got_recs, ref_recs, "tenant 0")
+    # parity of all-empty change lists proves nothing
+    assert sum(int(e["detections"]) for e in ref_recs) > 0
+    assert got_recs[-1]["rows_through"] == len(subs[0])
+    covered = 0
+    for ent in got_recs:
+        assert int(ent["rows_through"]) - int(ent["rows"]) <= covered
+        covered = max(covered, int(ent["rows_through"]))
+    assert covered == len(subs[0])  # every admitted row verdicted
+
+    # tenant 1 was never disturbed: its records match the reference too
+    got1 = _tenant_records([rB.verdicts_path], 1)
+    ref1 = _tenant_records([solo.verdicts_path], 1)
+    _assert_tenant_records_equal(got1, ref1, "tenant 1")
+
+
+def test_serve_mesh_tenants_matches_unmeshed(tmp_path, monkeypatch):
+    """ServeRunner accepts the tenant-mesh spec: a daemon on a 2-D
+    (tenant, partition) mesh produces flags bit-identical to the
+    unmeshed daemon on the same traffic."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(9, concepts=2, rows_per_concept=260,
+                                features=6)
+    lines = format_lines(stream.X, stream.y)
+
+    def drive(cfg):
+        r = ServeRunner(cfg, _params(stream, port=None, ops_port=None),
+                        keep_flags=True)
+        r.start()
+        for t in range(2):
+            r.admissions[t].admit_lines(lines[t::2])
+        r.batcher.flush()
+        r.request_stop()
+        assert r.serve_forever() == 0
+        return r.flags()
+
+    ref = drive(_cfg(tenants=2, seed=9))
+    got = drive(_cfg(tenants=2, seed=9, mesh_tenant_devices=2))
+    _assert_flags_equal(got, ref, "mesh-tenants daemon")
+
+
+def test_solo_fleet_posture_emits_tenant_entries(tmp_path, monkeypatch):
+    """A SINGLE-tenant backend in fleet posture (--tenants 1
+    --tenant-ids g) must emit per-tenant verdict entries carrying its
+    GLOBAL id — the fleet verdict tail joins on them, so without the
+    entry `loadgen --router` could never cover that tenant."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(3, concepts=2, rows_per_concept=200,
+                                features=6)
+    cfg = _cfg(str(tmp_path / "tele"), tenants=1)
+    r = ServeRunner(cfg, _params(stream, tenant_ids=(3,)), keep_flags=True)
+    banner, t = _start(r)
+    lines = format_lines(stream.X, stream.y)
+    with socket.create_connection(
+        ("127.0.0.1", banner["port"]), timeout=30
+    ) as sock:
+        sock.sendall(("\n".join(lines[:SPAN]) + "\n").encode())
+        sock.sendall(b"FLUSH\nSTOP\n")
+    t.join(timeout=120)
+    assert not t.is_alive()
+    recs = list(read_verdicts(banner["verdicts"]))
+    assert recs, "no verdicts published"
+    for rec in recs:
+        ents = rec.get("tenants")
+        assert ents and len(ents) == 1
+        assert int(ents[0]["id"]) == 3
+        assert int(ents[0]["rows_through"]) == int(rec["rows_through"])
+        assert int(ents[0]["start_row"]) == int(rec["start_row"])
+
+
+def test_savetenant_refuses_buffered_rows(tmp_path, monkeypatch):
+    """The control surface's safety rail: SAVETENANT under buffered
+    (unsealed) rows answers ERR and the daemon keeps serving."""
+    monkeypatch.chdir(tmp_path)
+    stream = planted_prototypes(3, concepts=2, rows_per_concept=200,
+                                features=6)
+    # linger long enough that the 7-row partial can NEVER seal under the
+    # test's feet — the ERR must come from the buffered-rows guard
+    r = ServeRunner(_cfg(None, tenants=2), _params(stream, linger_s=60.0),
+                    keep_flags=True)
+    banner, t = _start(r)
+    lines = format_lines(stream.X, stream.y)
+    with socket.create_connection(
+        ("127.0.0.1", banner["port"]), timeout=30
+    ) as sock:
+        # a partial span buffers without sealing
+        sock.sendall(
+            ("TENANT 0\n" + "\n".join(lines[:7]) + "\n").encode()
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if r.batcher.tenant_state(0)["buffered"] == 7:
+                break
+            time.sleep(0.02)
+        sock.sendall(
+            f"SAVETENANT 0 {tmp_path / 'x.ckpt'}\n".encode()
+        )
+        sock.settimeout(60)
+        buf = b""
+        while b"\n" not in buf:
+            buf += sock.recv(4096)
+        assert buf.startswith(b"ERR SAVETENANT 0")
+        assert b"buffered" in buf
+        # the daemon still serves: flush + stop drain cleanly
+        sock.sendall(b"FLUSH\nSTOP\n")
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert not os.path.exists(tmp_path / "x.ckpt")
