@@ -1458,6 +1458,115 @@ def fleet_bench(tenants: int, daemons: int, rows: int) -> None:
     )
 
 
+def _sched_stats(workers: int = 3, trials: int = 2) -> dict:
+    """``--sched``: the elastic-sweep-scheduler bench (ISSUE 15) — the
+    SAME grid swept twice: serially through the ``harness.grid`` CLI
+    (the paper's ``run_experiments.sh`` shape) and through the
+    ``sched/`` scheduler driving ``workers`` REAL worker subprocesses
+    (own GIL + jax runtime each), clean fleet (no injected faults — the
+    sched-smoke CI job owns the kill-a-worker proof; this bench refuses
+    to report a run whose registry audit is not exactly-once).
+
+    ``sched_cells_per_sec`` is the gated cell: cells completed per
+    wall-clock second of the scheduled sweep, subprocess launch to exit
+    — the fleet controller's whole claim is finishing a grid faster
+    than walking it. The serial rate and the speedup ratio print
+    informationally (both move with host load). Each mode gets its own
+    cold compile cache (no warm-start bias either way)."""
+    import shutil
+    import subprocess
+    import tempfile
+    import time as _time
+
+    mults, parts, per_batch = [1.0, 2.0, 4.0], [1, 2], 50
+    cells = len(mults) * len(parts) * trials
+    workdir = tempfile.mkdtemp(prefix="sched_bench_")
+    try:
+        spec_path = os.path.join(workdir, "spec.json")
+        sched_csv = os.path.join(workdir, "sched.csv")
+        with open(spec_path, "w") as fh:
+            json.dump(
+                {
+                    "dataset": "synth:rialto,seed=0",
+                    "mults": mults,
+                    "partitions": parts,
+                    "trials": trials,
+                    "per_batch": per_batch,
+                    "results_csv": sched_csv,
+                    "spec": "off",
+                },
+                fh,
+            )
+
+        def timed(cmd) -> "tuple[float, subprocess.CompletedProcess]":
+            t0 = _time.monotonic()
+            proc = subprocess.run(
+                cmd, cwd=_BENCH_DIR, capture_output=True, text=True,
+                timeout=1800,
+            )
+            span = _time.monotonic() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"sched bench command failed rc={proc.returncode}: "
+                    f"{proc.stderr[-1000:]}"
+                )
+            return span, proc
+
+        serial_span, _ = timed(
+            [
+                sys.executable, "-m",
+                "distributed_drift_detection_tpu.harness.grid",
+                "--dataset", "synth:rialto,seed=0",
+                "--mults", ",".join(str(m) for m in mults),
+                "--partitions", ",".join(str(p) for p in parts),
+                "--trials", str(trials), "--per-batch", str(per_batch),
+                "--spec", "off",
+                "--results-csv", os.path.join(workdir, "serial.csv"),
+                "--compile-cache-dir", os.path.join(workdir, "cache_serial"),
+            ]
+        )
+        sched_span, proc = timed(
+            [
+                sys.executable, "-m", "distributed_drift_detection_tpu",
+                "sched", spec_path,
+                "--telemetry-dir", os.path.join(workdir, "tele"),
+                "--workers", str(workers),
+                "--compile-cache-dir", os.path.join(workdir, "cache_sched"),
+                "--json", "--timeout", "1500",
+            ]
+        )
+        summary = json.loads(proc.stdout.splitlines()[-1])
+        if not (summary.get("whole") and summary["audit"]["ok"]):
+            raise RuntimeError(
+                f"scheduled sweep did not converge exactly-once: {summary}"
+            )
+        return {
+            "sched_cells": cells,
+            "sched_workers": workers,
+            "sched_cells_per_sec": round(cells / sched_span, 4),
+            "sched_serial_cells_per_sec": round(cells / serial_span, 4),
+            "sched_speedup": round(serial_span / sched_span, 2),
+            "sched_serial_span_s": round(serial_span, 2),
+            "sched_span_s": round(sched_span, 2),
+            "sched_evictions": summary["evictions"],
+            "sched_leases_granted": summary["leases_granted"],
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def sched_bench(workers: int, trials: int) -> None:
+    """--sched mode: print the scheduler-scaling stats as the one JSON
+    line (jax-free in THIS process — grid and fleet are subprocesses)."""
+    _emit(
+        {
+            "metric": "sched_cells_per_sec",
+            "unit": "cells/s",
+            **_sched_stats(workers, trials),
+        }
+    )
+
+
 def smoke() -> None:
     """--smoke mode: the CI-scale artifact-contract check — the headline
     measurement pipeline on the self-contained synthetic rialto stand-in
@@ -1654,6 +1763,7 @@ if __name__ == "__main__":
     is_serve = len(sys.argv) > 1 and sys.argv[1] == "--serve"
     is_tenants = len(sys.argv) > 1 and sys.argv[1] == "--tenants"
     is_fleet = len(sys.argv) > 1 and sys.argv[1] == "--fleet"
+    is_sched = len(sys.argv) > 1 and sys.argv[1] == "--sched"
     try:
         if is_soak:
             soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
@@ -1689,6 +1799,13 @@ if __name__ == "__main__":
                 int(sys.argv[3]) if len(sys.argv) > 3 else 2,
                 int(float(sys.argv[4])) if len(sys.argv) > 4 else 400_000,
             )
+        elif is_sched:
+            # --sched [WORKERS [TRIALS]] — cells/s of a scheduler-run
+            # grid (WORKERS worker subprocesses) vs the serial grid CLI.
+            sched_bench(
+                int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+                int(sys.argv[3]) if len(sys.argv) > 3 else 2,
+            )
         else:
             main()
     except Exception as e:  # still emit ONE parseable JSON line on failure
@@ -1706,6 +1823,8 @@ if __name__ == "__main__":
             metric = "tenant_agg_rows_per_sec"
         elif is_fleet:
             metric = "fleet_agg_rows_per_sec"
+        elif is_sched:
+            metric = "sched_cells_per_sec"
         _emit(
             {
                 "metric": metric,

@@ -68,6 +68,9 @@ runs experiments):
     python -m distributed_drift_detection_tpu timeline <DIR | logs...> [-o OUT]
     python -m distributed_drift_detection_tpu explain <DIR | run.jsonl | bundle>
     python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
+    python -m distributed_drift_detection_tpu sched [SPEC] --telemetry-dir DIR [...]
+    python -m distributed_drift_detection_tpu sched-worker --connect HOST:PORT [...]
+    python -m distributed_drift_detection_tpu registry compact DIR [...]
     python -m distributed_drift_detection_tpu doctor CSV [CSV ...]
 
 ``report`` renders a persisted run log (``--dir`` picks a telemetry
@@ -84,7 +87,15 @@ per-process logs into one timeline with straggler diagnostics
 registry's completed runs and emits — or ``--execute``s under the
 retry supervisor — the re-run plan for whatever a crash left missing
 (resilience.heal; plan mode is jax-free, exit 0 = sweep whole);
-``doctor`` validates CSV inputs against the ingest contract jax-free and
+``sched`` is the elastic sweep scheduler (sched subsystem,
+docs/SCHEDULER.md): it expands a sweep spec into cells, leases them to
+``sched-worker`` agents over a jax-free TCP control protocol, revokes
+dead/wedged workers' leases (the watch stall contract) and re-leases
+until the registry shows every cell completed exactly once — the
+paper's ``run_experiments.sh`` as a fleet controller; ``registry
+compact`` bounds a long-lived directory's ``index.jsonl``
+(telemetry.registry.compact_index); ``doctor`` validates CSV inputs
+against the ingest contract jax-free and
 exits nonzero on violations (io.sanitize — the pre-flight for sweeps);
 ``timeline`` merges one or many run logs (daemon + loadgen, or a
 multi-host fleet's per-process logs, clock-skew aligned) into a
@@ -113,6 +124,9 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu timeline DIR_OR_LOGS [-o OUT]\n"
     "       python -m distributed_drift_detection_tpu explain DIR_OR_LOG_OR_BUNDLE\n"
     "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
+    "       python -m distributed_drift_detection_tpu sched [SPEC] --telemetry-dir DIR [...]\n"
+    "       python -m distributed_drift_detection_tpu sched-worker --connect HOST:PORT [...]\n"
+    "       python -m distributed_drift_detection_tpu registry compact DIR [...]\n"
     "       python -m distributed_drift_detection_tpu doctor [--jobs N] CSV [CSV ...]\n"
     "       python -m distributed_drift_detection_tpu chunked CSV --classes C [...]"
 )
@@ -182,6 +196,28 @@ def main(argv: list[str]) -> None:
         from .resilience.heal import main as heal_main
 
         heal_main(argv[1:])
+        return
+    if argv and argv[0] == "sched":
+        # jax-free: the sweep scheduler daemon runs wherever the
+        # registry lands; only its WORKERS touch jax (sched subsystem,
+        # docs/SCHEDULER.md).
+        from .sched.scheduler import main as sched_main
+
+        sched_main(argv[1:])
+        return
+    if argv and argv[0] == "sched-worker":
+        # The worker agent: leases cells from a scheduler and runs them
+        # under the supervisor (jax lazily, per cell).
+        from .sched.worker import main as sched_worker_main
+
+        sched_worker_main(argv[1:])
+        return
+    if argv and argv[0] == "registry":
+        # jax-free: index.jsonl maintenance (compaction) wherever the
+        # artifact lands.
+        from .telemetry.registry import main as registry_main
+
+        registry_main(argv[1:])
         return
     if argv and argv[0] == "doctor":
         # jax-free: the ingest pre-flight runs wherever the data lands.

@@ -675,6 +675,48 @@ def telemetry_config_payload(cfg: RunConfig) -> dict:
     return payload
 
 
+# The payload fields config_from_payload accepts, with their normalizing
+# types — exactly the fields telemetry_config_payload can emit. One table
+# so the two directions cannot drift silently.
+_PAYLOAD_FIELDS: "dict[str, type]" = {
+    "dataset": str,
+    "model": str,
+    "detector": str,
+    "partitions": int,
+    "per_batch": int,
+    "mult_data": float,
+    "seed": int,
+    "backend": str,
+    "window": int,
+    "window_rotations": int,
+    "data_policy": str,
+    "tenants": int,
+}
+
+
+def config_from_payload(payload: dict, **extras) -> RunConfig:
+    """The inverse of :func:`telemetry_config_payload`: rebuild a runnable
+    :class:`RunConfig` from a digest payload plus the bookkeeping fields
+    the digest deliberately excludes (``results_csv``, ``time_string``,
+    ``telemetry_dir``, ...).
+
+    The ``sched/`` worker's cell-rebuild contract: a scheduler ships each
+    cell as its payload, the worker rebuilds and re-digests, and the two
+    must match byte-for-byte — so an *unknown* payload field fails loudly
+    here (schema drift between a newer scheduler and an older worker must
+    refuse to run the wrong experiment, the same posture as heal's
+    unknown-spec-key check). jax-free, like the rest of this module."""
+    unknown = set(payload) - set(_PAYLOAD_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"unknown config payload field(s) {sorted(unknown)}; known: "
+            f"{sorted(_PAYLOAD_FIELDS)}"
+        )
+    kw = {k: _PAYLOAD_FIELDS[k](v) for k, v in payload.items()}
+    kw.update(extras)
+    return RunConfig(**kw)
+
+
 def tenant_dataset(dataset: str, tenant: int) -> str:
     """Tenant ``t``'s dataset spec: any ``{tenant}`` placeholder in the
     configured dataset string is substituted with the tenant index, so one

@@ -55,6 +55,28 @@ SWEEP_DEFAULTS = {
 }
 
 
+def sweep_spec(dataset: str, mults, partitions, **knobs) -> dict:
+    """The sweep **as data**: the spec JSON ``heal`` diffs and the
+    ``sched/`` scheduler expands (``resilience.heal.load_spec`` is the
+    reader; this is the one writer). Unknown knobs fail loudly — the
+    same typo posture as the reader — and every omitted knob is filled
+    from :data:`SWEEP_DEFAULTS`, so a spec written here expands to
+    exactly the configs the grid CLI would run with those flags."""
+    unknown = set(knobs) - set(SWEEP_DEFAULTS)
+    if unknown:
+        raise ValueError(
+            f"unknown sweep knob(s) {sorted(unknown)}; known: "
+            f"{sorted(SWEEP_DEFAULTS)}"
+        )
+    return {
+        "dataset": str(dataset),
+        "mults": [float(m) for m in mults],
+        "partitions": [int(p) for p in partitions],
+        **SWEEP_DEFAULTS,
+        **knobs,
+    }
+
+
 def grid_configs(
     base: RunConfig,
     mults: list[float],
@@ -476,7 +498,34 @@ def main(argv=None) -> None:
         "at the end with the failed cells listed (heal --execute or a "
         "re-run finishes it)",
     )
+    ap.add_argument(
+        "--spec-out",
+        default="",
+        metavar="PATH",
+        help="also write this sweep as a spec JSON (sweep_spec) — the "
+        "artifact `heal` diffs and the sched/ scheduler re-runs, so the "
+        "exact grid is recoverable without reconstructing the flags",
+    )
     args = ap.parse_args(argv)
+
+    if args.spec_out:
+        import json
+
+        spec = sweep_spec(
+            args.dataset,
+            [float(m) for m in args.mults.split(",")],
+            [int(p) for p in args.partitions.split(",")],
+            models=args.models.split(","),
+            detectors=args.detectors.split(","),
+            trials=args.trials,
+            per_batch=args.per_batch,
+            results_csv=args.results_csv,
+            spec=args.spec,
+            data_policy=args.data_policy,
+        )
+        with open(args.spec_out, "w") as fh:
+            json.dump(spec, fh, indent=2, sort_keys=True)
+            fh.write("\n")
 
     base = RunConfig(
         dataset=args.dataset,

@@ -118,6 +118,19 @@ def host_identity() -> dict:
     return ident
 
 
+def fleet_worker_identity() -> dict:
+    """Identity extras a sweep-fleet worker agent (``sched/worker.py``)
+    stamps onto its scheduler hello: :func:`host_identity` plus the pid.
+    One copy of the contract, so the scheduler's ``/statusz`` worker rows
+    and the per-cell ``run_started`` extras (written by ``api.run``
+    through the same :func:`host_identity`) name workers consistently —
+    ``correlate`` then groups a scheduler-run sweep's per-worker logs
+    exactly like a pod's per-process logs."""
+    import os
+
+    return {**host_identity(), "pid": os.getpid()}
+
+
 def _distributed_identity() -> "tuple[int, int] | None":
     """``(process_id, num_processes)`` from jax's distributed runtime
     state when the control plane is initialized, else ``None``. Reads the

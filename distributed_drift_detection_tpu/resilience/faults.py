@@ -50,6 +50,19 @@ Sites (each named where the production code calls :func:`fire`):
                        ``kind='stall',seconds=S`` genuinely sleeps the
                        serve loop for S seconds (the wedge the SLO
                        ``stall_s`` rule and ops ``/healthz`` must catch)
+``sched.lease``        per lease grant in the sweep scheduler
+                       (``sched.scheduler.Scheduler``) — ``raise``
+                       rejects that one grant (the worker retries, the
+                       cell stays queued, the daemon survives);
+                       ``kind='stall',seconds=S`` wedges the grant
+``sched.worker``       per leased cell at execution start in the worker
+                       agent (``sched.worker.Worker.run``), OUTSIDE the
+                       per-cell error handling — ``raise`` kills the
+                       whole agent process, the deterministic worker
+                       preemption the exactly-once acceptance test and
+                       the sched-smoke CI job arm via ``DDD_FAULTS``
+                       (Bernoulli arming de-correlates per worker: the
+                       agent re-seeds with its ``--index``)
 =====================  ====================================================
 
 Arming is explicit (:func:`arm` in-process, or the ``DDD_FAULTS`` env var
@@ -112,6 +125,8 @@ SITES = frozenset(
         "stream.load",
         "serve.ingress",
         "serve.flush",
+        "sched.lease",
+        "sched.worker",
     }
 )
 

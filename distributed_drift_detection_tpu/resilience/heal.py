@@ -7,7 +7,8 @@ the same diff is a subcommand over first-class artifacts:
 
     python -m distributed_drift_detection_tpu heal sweep.json \\
         --telemetry-dir runs/ [--json plan.json] [--script missing.sh] \\
-        [--execute [--retries N] [--timeout-s S]] [--cell KEY ...]
+        [--execute [--retries N] [--timeout-s S]] [--cell KEY ...] \\
+        [--scheduler HOST:PORT]
 
 A **sweep spec** is the ``run_experiments.sh``-style grid as JSON —
 ``{"dataset": ..., "mults": [...], "partitions": [...], "models": [...],
@@ -27,7 +28,11 @@ recorded) is diffed against the registry's ``completed`` records:
 * ``--execute`` runs the missing trials in-process under the supervisor
   (:func:`..resilience.supervisor.supervised_run` with a retry policy),
   bracketed by a ``kind="heal"`` registry record, until the sweep is
-  whole.
+  whole;
+* ``--scheduler HOST:PORT`` pushes the plan to a running ``sched/``
+  scheduler instead (jax-free, like plan mode): the scheduler's worker
+  fleet runs the missing trials, and its exit code becomes the
+  wholeness contract (docs/SCHEDULER.md).
 
 Completed trials are never re-run: the diff is against the registry, the
 same source of truth ``watch``/``report --dir`` read. Plan mode is
@@ -198,6 +203,28 @@ def write_plan_script(
     os.chmod(path, 0o755)
 
 
+def submit_to_scheduler(spec: dict, plan: dict, addr: str) -> dict:
+    """Submit the plan's missing cells to a running ``sched/`` scheduler
+    over the jax-free control protocol — heal's push-mode alternative to
+    emitting a shell script: the scheduler's worker fleet runs the
+    missing trials instead of this process. The wire cells are built
+    through the same ``cell_to_wire`` the scheduler's own spec expansion
+    uses, so a heal-submitted cell and a spec-expanded cell are
+    byte-identical (digest and all). Returns the scheduler's ack
+    (``queued``/``duplicates`` counts — resubmitting a plan is
+    idempotent, like re-running the generated script)."""
+    from ..sched.protocol import ControlClient, cell_to_wire, parse_addr
+
+    by_name = {cfg.resolved_app_name(): cfg for cfg in spec_configs(spec)}
+    wires = [
+        cell_to_wire(by_name[cell["app_name"]], digest=cell["digest"])
+        for cell in plan["missing"]
+    ]
+    host, port = parse_addr(addr)
+    with ControlClient(host, port) as client:
+        return client.request({"op": "submit", "cells": wires})
+
+
 def execute(
     spec: dict,
     telemetry_dir: str,
@@ -288,6 +315,13 @@ def main(argv=None) -> None:
         "sweep is whole",
     )
     ap.add_argument(
+        "--scheduler", default=None, metavar="ADDR",
+        help="submit the missing-cell plan to a running sched/ scheduler "
+        "at HOST:PORT instead of running anything here (jax-free, like "
+        "plan mode); exits 0 once the submission is accepted — the "
+        "scheduler's own exit code is then the wholeness contract",
+    )
+    ap.add_argument(
         "--cell", action="append", default=None, metavar="KEY",
         help="with --execute: restrict to this cell (repeatable; the "
         "generated script uses one per line)",
@@ -328,6 +362,22 @@ def main(argv=None) -> None:
             retries=args.retries, timeout_s=args.timeout_s or None,
         )
         print(f"re-run script → {args.script}")
+    if args.scheduler:
+        if args.execute:
+            raise SystemExit(
+                "heal: --scheduler and --execute are mutually exclusive "
+                "(push the plan to the fleet OR run it here, not both)"
+            )
+        if plan["missing"]:
+            ack = submit_to_scheduler(spec, plan, args.scheduler)
+            print(
+                f"submitted {ack.get('queued', 0)} cell(s) to scheduler "
+                f"{args.scheduler} ({ack.get('duplicates', 0)} already "
+                "queued there)"
+            )
+        else:
+            print("sweep is whole — nothing to submit")
+        raise SystemExit(0)
     if args.execute and plan["missing"]:
         policy = RetryPolicy(
             max_attempts=max(args.retries, 0) + 1,

@@ -1,0 +1,37 @@
+"""Elastic sweep scheduler: the registry-driven fleet controller.
+
+The reference's experimental campaign is a 14-line bash loop
+(``run_experiments.sh``) walking a (multiplier × instances × memory ×
+cores) grid *serially*, with crash recovery done by hand from the
+notebook. PRs 3–5 built every primitive a real controller needs — the
+append-only run registry, the ``watch`` stall contract, ``heal``'s
+completed-cell diff, supervised retries, deterministic fault injection
+and atomic checkpoints — but a sweep was still one process walking a
+grid. This package inverts heal from pull to push:
+
+* :mod:`.scheduler` — the **scheduler daemon**: expands a sweep-spec
+  JSON into cells (the exact ``grid_configs`` expansion heal diffs),
+  treats the telemetry registry as the durable work ledger, grants
+  time-bounded **leases** to worker processes over a jax-free TCP
+  control protocol, revokes the leases of dead or wedged workers (the
+  ``watch`` stall contract applied to their heartbeats) and re-leases
+  their cells until the registry shows every cell completed exactly
+  once. Own ops plane (``/statusz``, ``/metrics`` ``sched_*``) and a
+  placement journal (``sched.journal.jsonl``).
+* :mod:`.worker` — the **worker agent** (``python -m … sched-worker``):
+  leases cells, runs each under ``resilience.supervisor`` with the
+  standard telemetry bracketing (so ``report``/``watch``/``correlate``/
+  ``top`` work unchanged), heartbeats while a cell runs, and reports
+  done/fail.
+* :mod:`.protocol` — the newline-JSON wire contract both sides speak
+  (and ``heal --scheduler`` submits plans through).
+* :mod:`.leases` — the pure lease/queue state machine + the
+  exactly-once registry audit.
+
+Everything except the worker's cell execution is jax-free: the
+scheduler runs wherever ``index.jsonl`` lands, exactly like
+``heal``'s plan mode. See ``docs/SCHEDULER.md``.
+"""
+
+from .leases import Cell, CellQueue, audit_exactly_once  # noqa: F401
+from .protocol import ControlClient, cell_to_wire, cell_from_wire  # noqa: F401

@@ -145,6 +145,18 @@ CELLS = (
     ("fleet_agg_rows_per_sec", _UP, True, "rows/s"),
     ("fleet_agg_rows_per_sec_d1", _UP, False, "rows/s"),
     ("fleet_speedup", _UP, False, "x"),
+    # Elastic sweep scheduler (bench.py --sched, r15+): cells completed
+    # per wall-clock second of a scheduler-run grid (3 worker
+    # subprocesses, lease/heartbeat control plane, registry-audited
+    # exactly-once). GATED — the fleet controller's whole claim is
+    # finishing a grid faster than walking it serially, and a regression
+    # is a code property of the sched/ control plane. The serial rate
+    # and the speedup ratio print informationally (both move with host
+    # load; the acceptance bar is speedup ≥ 1.5× at 3 workers, recorded
+    # in the artifact).
+    ("sched_cells_per_sec", _UP, True, "cells/s"),
+    ("sched_serial_cells_per_sec", _UP, False, "cells/s"),
+    ("sched_speedup", _UP, False, "x"),
     # Adaptation recovery (bench.py --serve adapt rider, r12+): rows from
     # a drift verdict until post-drift chunk error returns within the
     # policy's epsilon of the pre-drift level, on the planted
@@ -431,6 +443,9 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "fleet_agg_rows_per_sec",
         "fleet_agg_rows_per_sec_d1",
         "fleet_speedup",
+        "sched_cells_per_sec",
+        "sched_serial_cells_per_sec",
+        "sched_speedup",
         "serve_adapt_recovery_rows",
         "mean_delay_batches",
         "detections",

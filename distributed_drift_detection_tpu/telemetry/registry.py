@@ -38,16 +38,19 @@ import time
 INDEX_NAME = "index.jsonl"
 
 # Row-record sidecar suffixes that live next to a run log but are not run
-# logs: quarantine sidecars (io.sanitize) and the serving daemon's verdict /
-# heartbeat sidecars (serve.runner). ``newest_run_log`` must never resolve
-# one — on a *live* serving directory the verdict sidecar is usually the
-# most recently appended ``*.jsonl``, and resolving it would hand ``report
-# --dir`` / ``watch <dir>`` a file that fails event-schema validation.
+# logs: quarantine sidecars (io.sanitize), the serving daemon's verdict /
+# heartbeat sidecars (serve.runner), and placement journals (serve.router's
+# ``router.journal.jsonl``, the scheduler's ``sched.journal.jsonl``).
+# ``newest_run_log`` must never resolve one — on a *live* serving directory
+# the verdict sidecar is usually the most recently appended ``*.jsonl``, and
+# resolving it would hand ``report --dir`` / ``watch <dir>`` a file that
+# fails event-schema validation.
 SIDECAR_SUFFIXES = (
     "quarantine.jsonl",
     "verdicts.jsonl",
     "heartbeat.jsonl",
     "flightrec.jsonl",
+    "journal.jsonl",
 )
 
 # The only statuses the fold recognizes; producers writing anything else
@@ -94,6 +97,29 @@ def current_attempt() -> "int | None":
     return _ATTEMPT.get()
 
 
+def _open_locked_append(path: str):
+    """Open ``path`` for append with an exclusive ``flock``, re-opening
+    if a compaction replaced the inode between open and lock (the
+    standard flock-with-rename dance: without the re-stat, a writer that
+    opened the pre-compaction file would append its record to an
+    unlinked inode and silently lose it). Non-POSIX / no-flock
+    filesystems degrade to the plain append the registry always did."""
+    while True:
+        fh = open(path, "a")
+        try:
+            import fcntl
+
+            fcntl.flock(fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            return fh  # best-effort append (pre-compaction behaviour)
+        try:
+            if os.fstat(fh.fileno()).st_ino == os.stat(path).st_ino:
+                return fh
+        except OSError:
+            pass  # replaced and momentarily absent: reopen
+        fh.close()
+
+
 def record(telemetry_dir: str, run_id: str, status: str, **extras) -> dict:
     """Append one status record; returns it. Creates the directory and
     index on first use. ``extras`` ride along verbatim (``config_digest``,
@@ -108,7 +134,8 @@ def record(telemetry_dir: str, run_id: str, status: str, **extras) -> dict:
         extras.setdefault("attempt", attempt)
     rec = {"ts": time.time(), "run_id": str(run_id), "status": status, **extras}
     os.makedirs(telemetry_dir, exist_ok=True)
-    with open(index_path(telemetry_dir), "a") as fh:
+    fh = _open_locked_append(index_path(telemetry_dir))
+    with fh:
         fh.write(json.dumps(rec) + "\n")
         fh.flush()
         # fsync like the results CSV: the registry is what `heal` diffs a
@@ -211,3 +238,141 @@ def newest_run_log(telemetry_dir: str) -> str | None:
         if best is not None:
             return best[1]
     return None
+
+
+# --- compaction --------------------------------------------------------------
+#
+# A long-lived producer (the sched/ scheduler appends a record per lease
+# attempt; a serving farm appends per run) grows index.jsonl without bound,
+# and every fold (`runs()`, heal's digest diff, `newest_run_log`) re-reads
+# the whole timeline. Compaction rewrites the index as ONE record per
+# run_id — its current folded state, stamped with its *start* time — which
+# preserves every semantic the consumers rely on:
+#
+# * `runs()` folds the compacted index to the same current-state map
+#   (extras were already merged by the fold that produced the snapshot);
+# * `newest_run_log` ranks registered runs by start (ts == started_ts);
+# * heal / sched audit digest-matching sees the same `completed` multiset.
+#
+# What it deliberately drops is the *history* (failed→completed attempt
+# timelines collapse to the final state, with the latest record's fields);
+# the per-run event logs remain the evidence trail.
+
+
+def compact_index(telemetry_dir: str) -> "dict | None":
+    """Atomically compact ``index.jsonl`` to one record per run; returns
+    ``{records_before, records_after}`` (``None`` when there is nothing
+    to compact).
+
+    Crash-safe by construction: the snapshot is written to a temp file,
+    fsynced, and ``os.replace``d over the index — a compaction torn at
+    any point leaves either the intact old index (+ a stray ``*.tmp``
+    the next compaction overwrites) or the complete new one, never a
+    half-written index. Concurrent appenders are excluded by the same
+    ``flock`` :func:`record` takes (and re-check the inode after locking,
+    so no record can land on the unlinked pre-compaction file)."""
+    path = index_path(telemetry_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path, "a") as lock_fh:
+        try:
+            import fcntl
+
+            fcntl.flock(lock_fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # best-effort exclusion (same posture as record())
+        before = read_index(telemetry_dir)
+        if not before:
+            return None
+        folded = sorted(
+            runs(telemetry_dir).values(), key=lambda r: r["started_ts"]
+        )
+        tmp = f"{path}.compact-{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            for rec in folded:
+                rec = dict(rec)
+                # ts = start: the fold re-derives started_ts from the
+                # first (now only) record, keeping newest_run_log's
+                # newest-*start* ranking exact across compaction.
+                rec["ts"] = rec.pop("started_ts")
+                fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    return {"records_before": len(before), "records_after": len(folded)}
+
+
+# Amortization state for maybe_compact: index path → record count right
+# after its last compaction in this process (the floor the index cannot
+# shrink below — one folded record per run). Without it, a directory
+# whose *distinct-run* count exceeds the threshold would trigger a full
+# O(n) rewrite on every subsequent append, quadratic in sweep size.
+_COMPACT_FLOOR: "dict[str, int]" = {}
+
+
+def maybe_compact(telemetry_dir: str, *, max_records: int) -> "dict | None":
+    """Compact when the index holds more than ``max_records`` records —
+    the auto-compaction hook a long-lived scheduler calls as completions
+    land. Cheap when under threshold (one line count, no JSON parse),
+    and amortized O(1) per append past it: once a compaction has run,
+    the next one waits until the index doubles past that compaction's
+    floor (compaction cannot shrink below one record per run, so
+    re-compacting sooner would be a full rewrite for nothing)."""
+    if max_records <= 0:
+        return None
+    path = index_path(telemetry_dir)
+    try:
+        with open(path, "rb") as fh:
+            lines = sum(1 for _ in fh)
+    except OSError:
+        return None
+    key = os.path.realpath(path)
+    if lines <= max(max_records, 2 * _COMPACT_FLOOR.get(key, 0)):
+        return None
+    out = compact_index(telemetry_dir)
+    if out is not None:
+        _COMPACT_FLOOR[key] = out["records_after"]
+    return out
+
+
+def main(argv=None) -> None:
+    """``registry`` subcommand: jax-free index maintenance.
+
+        python -m distributed_drift_detection_tpu registry compact DIR \\
+            [--min-records N]
+
+    ``compact`` rewrites DIR's ``index.jsonl`` as one record per run
+    (see :func:`compact_index`); with ``--min-records`` it is a no-op
+    below the threshold (the cron-safe form). Exit 0 either way; the
+    summary goes to stdout."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_drift_detection_tpu registry",
+        description=main.__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("action", choices=["compact"])
+    ap.add_argument("dir", help="telemetry directory (holds index.jsonl)")
+    ap.add_argument(
+        "--min-records", type=int, default=0, metavar="N",
+        help="only compact past N records (default: always)",
+    )
+    args = ap.parse_args(argv)
+    if args.min_records > 0:
+        out = maybe_compact(args.dir, max_records=args.min_records)
+    else:
+        out = compact_index(args.dir)
+    if out is None:
+        print("registry: nothing to compact")
+    else:
+        print(
+            f"registry: compacted {out['records_before']} → "
+            f"{out['records_after']} records"
+        )
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1:])

@@ -123,6 +123,8 @@ class StatuszSource:
                 "alerts": [f"unreachable: {getattr(e, 'reason', e)}"],
                 "age_s": None,
             }
+        if s.get("sched"):
+            return self._sched_row(s, now_mono)
         rows = (s.get("rows") or {}).get("published")
         rate, self._prev = _frame_rate(
             self._prev,
@@ -168,6 +170,49 @@ class StatuszSource:
             "wire": wire,
             "alerts": sorted(a["rule"] for a in s.get("alerts") or []),
             "age_s": s.get("last_verdict_age_s"),
+        }
+
+    def _sched_row(self, s: dict, now_mono: float) -> dict:
+        """A sweep scheduler's ``/statusz`` (sched/scheduler.py): the row
+        reads like a daemon whose "rows" are the fleet's cumulative cell
+        rows, with the queue/lease/worker health riding the WIRE column —
+        the PR-14 router-row pattern for the control plane."""
+        cells = s.get("cells") or {}
+        workers = s.get("workers") or []
+        alive = sum(1 for w in workers if w.get("alive"))
+        rows = sum(int(w.get("rows_done") or 0) for w in workers) or None
+        rate, self._prev = _frame_rate(
+            self._prev,
+            now_mono,
+            rows,
+            lambda: rows / s["uptime_s"] if rows and s.get("uptime_s") else None,
+        )
+        fleet = (
+            f"q:{cells.get('queued', 0)} l:{cells.get('leased', 0)} "
+            f"c:{cells.get('completed', 0)} f:{cells.get('failed', 0)} "
+            f"wk:{alive}/{len(workers)}"
+        )
+        if s.get("evictions"):
+            fleet += f" ev:{s['evictions']}"
+        alerts = []
+        if cells.get("failed"):
+            alerts.append("cells_failed")
+        ages = [
+            w.get("age_s") for w in workers
+            if w.get("alive") and w.get("age_s") is not None
+        ]
+        return {
+            "run": s.get("run_id") or self.url,
+            "status": "done" if s.get("whole") else "sched",
+            "rows": rows,
+            "rows_per_sec": rate,
+            "p50_ms": None,
+            "p99_ms": None,
+            "detections": None,
+            "quarantined": None,
+            "wire": fleet,
+            "alerts": alerts,
+            "age_s": min(ages) if ages else None,
         }
 
 

@@ -195,13 +195,30 @@ def resolve_log(path: str) -> str | None:
     return path if os.path.exists(path) else None
 
 
+def staleness_s(
+    last_ts: "float | None", path: "str | None" = None, *, now: float
+) -> float:
+    """Seconds since the last sign of life — THE stall-contract quantity.
+
+    One copy of the semantics shared by the watch loop (event timestamps,
+    falling back to the log file's mtime while the log is still empty)
+    and the ``sched/`` scheduler (per-worker heartbeat stamps: a worker
+    whose staleness exceeds its lease TTL is dead or wedged either way,
+    exactly the ``--stall-after`` contract applied to the control
+    plane). Clamped at 0 — a clock skewed slightly ahead must not read
+    as negative staleness."""
+    if last_ts is not None:
+        return max(now - last_ts, 0.0)
+    if path is not None:
+        try:
+            return max(now - os.path.getmtime(path), 0.0)
+        except OSError:
+            pass
+    return 0.0
+
+
 def _age(state: WatchState, log_path: str, now: float) -> float:
-    if state.last_ts is not None:
-        return now - state.last_ts
-    try:
-        return now - os.path.getmtime(log_path)
-    except OSError:
-        return 0.0
+    return staleness_s(state.last_ts, log_path, now=now)
 
 
 def watch(

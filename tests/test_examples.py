@@ -86,3 +86,12 @@ def test_sweep_and_plots_example(tmp_path):
     assert (tmp_path / "sweep_runs.csv").exists()
     figs = tmp_path / "figures"
     assert figs.exists() and any(figs.iterdir())
+
+@pytest.mark.slow
+def test_sched_sweep_example(tmp_path):
+    """The paper grid via the scheduler: 12 cells over a 2-worker fleet
+    (multi-process — slow tier)."""
+    out = run_example(tmp_path, "sched_sweep.py", "synth:rialto,seed=0", 2)
+    assert "sweep whole: 12/12" in out
+    assert os.path.exists(tmp_path / "sched_sweep_runs.csv")
+    assert os.path.exists(tmp_path / "sched_runs" / "sched.journal.jsonl")

@@ -1,0 +1,587 @@
+"""sched/ subsystem: protocol, lease state machine, scheduler daemon,
+worker agent, heal submission, exactly-once audit.
+
+The fast tier drives everything in-process with jax-free stub executors
+(the control plane never touches jax by design); the slow tier is the
+multi-process acceptance proof — a 12-cell grid run by 3 worker
+subprocesses with seeded fault injection killing workers at random,
+converging to every cell completed exactly once with result rows
+bit-identical to a serial grid run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_drift_detection_tpu.config import (
+    RunConfig,
+    config_from_payload,
+    telemetry_config_payload,
+)
+from distributed_drift_detection_tpu.harness.grid import (
+    grid_configs,
+    sweep_spec,
+)
+from distributed_drift_detection_tpu.resilience import faults, heal
+from distributed_drift_detection_tpu.sched import protocol
+from distributed_drift_detection_tpu.sched.leases import (
+    CellQueue,
+    audit_exactly_once,
+)
+from distributed_drift_detection_tpu.sched.scheduler import Scheduler
+from distributed_drift_detection_tpu.sched.worker import Worker
+from distributed_drift_detection_tpu.telemetry import registry
+
+
+def _spec(tmp_path, mults=(1, 2, 4), partitions=(1, 2), trials=1):
+    return sweep_spec(
+        "synth:rialto,seed=0",
+        list(mults),
+        list(partitions),
+        trials=trials,
+        per_batch=50,
+        results_csv=str(tmp_path / "results.csv"),
+        spec="off",
+    )
+
+
+def _wires(spec):
+    return [protocol.cell_to_wire(cfg) for cfg in heal.spec_configs(spec)]
+
+
+def _stub_run_cell(cell, tele_dir, retries=0):
+    """Mimic api.run's registry bracket without jax."""
+    rid = f"stub-{cell['app_name']}"
+    registry.record(tele_dir, rid, "running", config_digest=cell["digest"])
+    registry.record(tele_dir, rid, "completed", config_digest=cell["digest"])
+    return {"rows": 100, "total_time": 0.01, "detections": 1}
+
+
+# --- protocol ---------------------------------------------------------------
+
+
+def test_protocol_roundtrip_and_rejection():
+    msg = {"op": "lease", "worker": "w0"}
+    assert protocol.decode_line(protocol.encode(msg).strip()) == msg
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_line(b"not json")
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_line(b'["no", "op"]')
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_line(b'{"noop": 1}')
+    assert protocol.parse_addr("127.0.0.1:9000") == ("127.0.0.1", 9000)
+    assert protocol.parse_addr(":9000") == ("127.0.0.1", 9000)
+    with pytest.raises(ValueError):
+        protocol.parse_addr("nope")
+
+
+def test_cell_wire_roundtrip_pins_digest():
+    cfg = grid_configs(
+        RunConfig(dataset="synth:rialto,seed=0", per_batch=50),
+        mults=[2.0], partitions=[4], trials=1,
+    )[0]
+    wire = protocol.cell_to_wire(cfg)
+    assert wire["digest"] == registry.config_digest(
+        telemetry_config_payload(cfg)
+    )
+    rebuilt = protocol.cell_from_wire(wire, telemetry_dir="/tmp/x")
+    assert telemetry_config_payload(rebuilt) == wire["payload"]
+    assert rebuilt.resolved_app_name() == wire["app_name"]
+    assert rebuilt.telemetry_dir == "/tmp/x"
+    # Schema drift between scheduler and worker must refuse to run: a
+    # tampered payload rebuilds to a different digest.
+    bad = {**wire, "payload": {**wire["payload"], "seed": 99}}
+    with pytest.raises(protocol.ProtocolError, match="digest"):
+        protocol.cell_from_wire(bad)
+
+
+def test_config_from_payload_rejects_unknown_fields():
+    cfg = RunConfig(dataset="synth:rialto,seed=0", per_batch=50)
+    payload = telemetry_config_payload(cfg)
+    back = config_from_payload(payload, results_csv="r.csv")
+    assert telemetry_config_payload(back) == payload
+    assert back.results_csv == "r.csv"
+    with pytest.raises(ValueError, match="unknown config payload"):
+        config_from_payload({**payload, "surprise": 1})
+
+
+def test_sweep_spec_writer_matches_reader(tmp_path):
+    spec = _spec(tmp_path)
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec))
+    loaded = heal.load_spec(str(path))
+    # The writer fills every knob, so the reader's defaults change nothing
+    # and both expand to the same trial configs (digest-for-digest).
+    assert [c["digest"] for c in _wires(loaded)] == [
+        c["digest"] for c in _wires(spec)
+    ]
+    with pytest.raises(ValueError, match="unknown sweep knob"):
+        sweep_spec("d", [1], [1], model="typo")
+
+
+# --- lease state machine ----------------------------------------------------
+
+
+def test_cellqueue_lease_lifecycle(tmp_path):
+    q = CellQueue(lease_s=10.0, max_attempts=2)
+    # 6 trials of ONE geometry: grant order below is pure sweep order
+    # (the affinity tie-breaks are pinned by test_grant_geometry_affinity).
+    spec = _spec(tmp_path, mults=(1,), partitions=(1,), trials=6)
+    queued, dups = q.add(_wires(spec))
+    assert (queued, dups) == (6, 0)
+    assert q.add(_wires(spec)) == (0, 6)  # idempotent
+    now = 100.0
+    lease = q.grant("w0", now)
+    assert lease is not None and lease.cell.state == "leased"
+    # Heartbeats refresh the TTL; silence past it revokes.
+    assert q.heartbeat(lease.lease_id, now + 5)
+    assert q.revoke_expired(now + 14.9) == []
+    expired = q.revoke_expired(now + 15.1)
+    assert [e.lease_id for e in expired] == [lease.lease_id]
+    assert lease.cell.state == "queued"  # one attempt left
+    # A done for the revoked lease is discarded — at-most-once-recorded.
+    assert q.complete(lease.lease_id, "w0") is None
+    lease2 = q.grant("w1", now + 20)
+    assert lease2.cell is lease.cell and lease2.cell.attempts == 2
+    assert q.complete(lease2.lease_id, "w1") is lease2.cell
+    assert lease2.cell.state == "completed"
+    # Another worker's report on someone else's lease is discarded too.
+    lease3 = q.grant("w0", now + 21)
+    assert q.complete(lease3.lease_id, "w9") is None
+    # fail: requeue while attempts remain, terminal past the budget.
+    cell3, requeued = q.fail(lease3.lease_id, "w0")
+    assert requeued and cell3.state == "queued"
+    lease4 = q.grant("w0", now + 22)
+    assert lease4.cell is cell3
+    cell4, requeued = q.fail(lease4.lease_id, "w0")
+    assert not requeued and cell4.state == "failed"
+    counts = q.counts()
+    assert counts["completed"] == 1 and counts["failed"] == 1
+    assert not q.whole()  # 4 cells still queued
+
+
+def test_grant_geometry_affinity(tmp_path):
+    """Trials of one sweep config stick to the worker that already
+    compiled it; cold geometries spread across the fleet."""
+    q = CellQueue(lease_s=10.0, max_attempts=3)
+    # 2 geometries × 2 trials, sweep order g1t0 g1t1 g2t0 g2t1.
+    q.add(_wires(_spec(tmp_path, mults=(1, 2), partitions=(1,), trials=2)))
+    a = q.grant("w0", 0.0)  # first cell (g1 now w0's)
+    b = q.grant("w1", 0.0)  # fresh geometry g2, NOT g1's second trial
+    assert b.cell.geometry != a.cell.geometry
+    q.complete(a.lease_id, "w0")
+    q.complete(b.lease_id, "w1")
+    a2 = q.grant("w0", 0.0)
+    b2 = q.grant("w1", 0.0)
+    assert a2.cell.geometry == a.cell.geometry  # affinity match
+    assert b2.cell.geometry == b.cell.geometry
+    # Trials of one geometry differ only by seed.
+    assert a2.cell.digest != a.cell.digest
+
+
+def test_cellqueue_disconnect_revokes_all_held(tmp_path):
+    q = CellQueue(lease_s=10.0, max_attempts=3)
+    q.add(_wires(_spec(tmp_path)))
+    a, b = q.grant("w0", 0.0), q.grant("w0", 0.0)
+    q.grant("w1", 0.0)
+    held = q.revoke_worker("w0")
+    assert {lease.lease_id for lease in held} == {a.lease_id, b.lease_id}
+    assert a.cell.state == "queued" and b.cell.state == "queued"
+    assert len(q.leases) == 1  # w1's survives
+
+
+def test_audit_exactly_once(tmp_path):
+    tele = str(tmp_path)
+    q = CellQueue(lease_s=1.0)
+    q.add(_wires(_spec(tmp_path, mults=(1, 2), partitions=(1,))))
+    expected = q.expected_digests()
+    d1, d2 = sorted(expected)
+    audit = audit_exactly_once(tele, expected)
+    assert not audit["ok"] and set(audit["missing"]) == {d1, d2}
+    registry.record(tele, "r1", "completed", config_digest=d1)
+    registry.record(tele, "r2", "completed", config_digest=d2)
+    audit = audit_exactly_once(tele, expected)
+    assert audit["ok"], audit
+    # A duplicate completion (two run_ids, one digest) is the violation.
+    registry.record(tele, "r3", "completed", config_digest=d1)
+    audit = audit_exactly_once(tele, expected)
+    assert not audit["ok"] and audit["duplicates"] == {d1: 1}
+
+
+# --- scheduler daemon (in-process, stub executors) --------------------------
+
+
+def test_scheduler_end_to_end_with_stub_workers(tmp_path):
+    tele = str(tmp_path / "tele")
+    sched = Scheduler(tele, lease_s=30.0, ops_port=0)
+    plan = sched.add_spec(_spec(tmp_path))
+    assert plan == {"cells_total": 6, "completed": 0, "queued": 6}
+    sched.start()
+    try:
+        workers = [
+            Worker(
+                "127.0.0.1", sched.port, worker_id=f"stub{i}",
+                run_cell=_stub_run_cell, progress=lambda _m: None,
+            )
+            for i in range(2)
+        ]
+        threads = [
+            threading.Thread(target=w.run, daemon=True) for w in workers
+        ]
+        for t in threads:
+            t.start()
+        assert sched.wait_whole(timeout=30), sched.status()
+        for t in threads:
+            t.join(timeout=10)
+        assert sum(w.cells_done for w in workers) == 6
+        status = sched.status()
+        assert status["cells"]["completed"] == 6
+        assert len(status["workers"]) == 2
+        assert status["cells_per_sec"] is None or status["cells_per_sec"] >= 0
+        # The ops plane serves sched_* metrics and a healthy /healthz.
+        import urllib.request
+
+        base = f"http://127.0.0.1:{sched.ops_port}"
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "sched_cells_completed_total 6" in text
+        assert "sched_workers_connected" in text
+        health = json.load(urllib.request.urlopen(base + "/healthz"))
+        assert health["healthy"]
+    finally:
+        summary = sched.stop()
+    assert summary["whole"] and summary["audit"]["ok"], summary
+    # The registry carries the sched bracket: running → completed.
+    recs = [
+        r for r in registry.runs(tele).values() if r.get("kind") == "sched"
+    ]
+    assert len(recs) == 1 and recs[0]["status"] == "completed"
+    assert recs[0]["audit_ok"] is True
+    # The placement journal recorded grants and completions.
+    journal = [
+        json.loads(ln)
+        for ln in open(os.path.join(tele, "sched.journal.jsonl"))
+    ]
+    events = {j["event"] for j in journal}
+    assert {"scheduler_started", "lease_granted", "cell_completed",
+            "scheduler_stopped"} <= events
+    # The journal is a sidecar, never "the newest run log".
+    assert registry.newest_run_log(tele) is None
+
+
+def test_scheduler_resumes_from_registry(tmp_path):
+    """Cells the registry already shows completed are never re-leased."""
+    tele = str(tmp_path / "tele")
+    spec = _spec(tmp_path)
+    wires = _wires(spec)
+    for wire in wires[:4]:
+        _stub_run_cell(wire, tele)
+    sched = Scheduler(tele, lease_s=30.0)
+    plan = sched.add_spec(spec)
+    assert plan == {"cells_total": 6, "completed": 4, "queued": 2}
+    sched.start()
+    try:
+        w = Worker(
+            "127.0.0.1", sched.port, worker_id="s0",
+            run_cell=_stub_run_cell, progress=lambda _m: None,
+        )
+        assert w.run() == 0
+        assert w.cells_done == 2
+        assert sched.wait_whole(timeout=10)
+    finally:
+        summary = sched.stop()
+    assert summary["whole"] and summary["audit"]["ok"], summary
+    assert summary["leases_granted"] == 2
+
+
+def test_scheduler_revokes_silent_worker_and_releases(tmp_path):
+    """The stall contract: a leased worker that stops heartbeating loses
+    the cell; its late completion is discarded (exactly-once)."""
+    tele = str(tmp_path / "tele")
+    sched = Scheduler(tele, lease_s=0.4, ops_port=None)
+    sched.add_spec(_spec(tmp_path, mults=(1,), partitions=(1,)))
+    sched.start()
+    try:
+        dead = protocol.ControlClient("127.0.0.1", sched.port)
+        dead.request({"op": "hello", "worker": "wedged"})
+        lease = dead.request({"op": "lease", "worker": "wedged"})
+        assert lease["op"] == "lease"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sched.status()["evictions"]:
+                break
+            time.sleep(0.05)
+        assert sched.status()["evictions"] == 1
+        # The unwedged worker's late report must be discarded.
+        late = dead.request(
+            {"op": "done", "worker": "wedged",
+             "lease_id": lease["lease_id"], "result": {}}
+        )
+        assert late == {"op": "ack", "accepted": False}
+        # The cell re-leases to a live worker and the sweep closes.
+        w = Worker(
+            "127.0.0.1", sched.port, worker_id="alive",
+            run_cell=_stub_run_cell, progress=lambda _m: None,
+        )
+        assert w.run() == 0 and w.cells_done == 1
+        assert sched.wait_whole(timeout=10)
+    finally:
+        summary = sched.stop()
+    assert summary["whole"] and summary["leases_revoked"] == 1, summary
+
+
+def test_scheduler_survives_armed_lease_fault(tmp_path):
+    """`sched.lease:at=1` rejects the first grant; the worker backs off
+    and the retry succeeds — a grant failure is never a daemon crash."""
+    tele = str(tmp_path / "tele")
+    faults.arm("sched.lease", at=1, times=1)
+    try:
+        sched = Scheduler(tele, lease_s=30.0)
+        sched.add_spec(_spec(tmp_path, mults=(1,), partitions=(1,)))
+        sched.start()
+        try:
+            rejected = []
+            w = Worker(
+                "127.0.0.1", sched.port, worker_id="w0",
+                run_cell=_stub_run_cell, sleep=lambda _s: None,
+                progress=lambda m: rejected.append(m),
+            )
+            assert w.run() == 0 and w.cells_done == 1
+            assert any("lease rejected" in m for m in rejected)
+            assert sched.status()["lease_errors"] == 1
+        finally:
+            summary = sched.stop()
+        assert summary["whole"], summary
+    finally:
+        faults.disarm_all()
+
+
+def test_worker_abandons_cell_on_revoked_heartbeat(tmp_path):
+    """A wedged-then-unwedged worker: the heartbeat reply `revoked`
+    makes the agent abandon the cell — no done report, no double count."""
+    tele = str(tmp_path / "tele")
+    sched = Scheduler(tele, lease_s=0.5, heartbeat_s=0.05)
+    sched.add_spec(_spec(tmp_path, mults=(1,), partitions=(1,)))
+    sched.start()
+    try:
+        release = threading.Event()
+        calls = []
+
+        def wedged_run_cell(cell, tele_dir, retries=0):
+            calls.append(1)
+            if len(calls) == 1:
+                # First attempt: the test revokes the lease behind the
+                # agent's back mid-cell; the attempt "finishes" after
+                # the revocation WITHOUT recording anything (the killed
+                # worker whose registry record never landed).
+                release.wait(10)
+                return {"rows": 0, "total_time": 0.0, "detections": 0}
+            return _stub_run_cell(cell, tele_dir)
+
+        w = Worker(
+            "127.0.0.1", sched.port, worker_id="w0",
+            run_cell=wedged_run_cell, progress=lambda _m: None,
+        )
+        t = threading.Thread(target=w.run, daemon=True)
+        t.start()
+        # Wait until the lease exists, then revoke it behind the
+        # worker's back (the in-process twin of a stall revocation).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not sched.queue.leases:
+            time.sleep(0.02)
+        with sched._lock:
+            held = sched.queue.revoke_worker("w0")
+        assert len(held) == 1
+        release.set()
+        # The agent sees `revoked` on its next heartbeat or discovers
+        # the discarded done; either way it records nothing.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not sched.queue.whole():
+            # the revoked cell re-queued; let the same agent re-lease it
+            time.sleep(0.05)
+        assert sched.wait_whole(timeout=10)
+        t.join(timeout=10)
+        assert w.cells_done == 1  # the re-leased run, not the revoked one
+    finally:
+        summary = sched.stop()
+    assert summary["whole"], summary
+
+
+def test_scheduler_submit_and_heal_push(tmp_path):
+    """`heal --scheduler` submits exactly the missing plan; submissions
+    are idempotent."""
+    tele = str(tmp_path / "tele")
+    spec = _spec(tmp_path)
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    wires = _wires(spec)
+    for wire in wires[:2]:
+        _stub_run_cell(wire, tele)
+    sched = Scheduler(tele, lease_s=30.0)
+    sched.start()
+    try:
+        with pytest.raises(SystemExit) as exc:
+            heal.main([
+                str(spec_path), "--telemetry-dir", tele,
+                "--scheduler", f"127.0.0.1:{sched.port}",
+            ])
+        assert exc.value.code == 0
+        assert sched.status()["cells"]["total"] == 4
+        # Resubmission queues nothing new.
+        ack = heal.submit_to_scheduler(
+            heal.load_spec(str(spec_path)),
+            heal.sweep_plan(heal.load_spec(str(spec_path)), tele),
+            f"127.0.0.1:{sched.port}",
+        )
+        assert ack["queued"] == 0 and ack["duplicates"] == 4
+        w = Worker(
+            "127.0.0.1", sched.port, worker_id="w0",
+            run_cell=_stub_run_cell, progress=lambda _m: None,
+        )
+        assert w.run() == 0 and w.cells_done == 4
+    finally:
+        summary = sched.stop()
+    assert summary["whole"] and summary["audit"]["ok"], summary
+    # After the fleet ran, the spec diffs whole — plan mode exits 0.
+    with pytest.raises(SystemExit) as exc:
+        heal.main([str(spec_path), "--telemetry-dir", tele])
+    assert exc.value.code == 0
+
+
+def test_scheduler_rejects_malformed_submit(tmp_path):
+    sched = Scheduler(str(tmp_path / "tele"), lease_s=30.0)
+    sched.start()
+    try:
+        client = protocol.ControlClient("127.0.0.1", sched.port)
+        with pytest.raises(protocol.ProtocolError, match="wire cells"):
+            client.request({"op": "submit", "cells": [{"nope": 1}]})
+        with pytest.raises(protocol.ProtocolError, match="unknown op"):
+            client.request({"op": "gibberish"})
+        # Malformed line: the reply is an error, the connection lives.
+        client.connect()
+        client._sock.sendall(b"not json\n")
+        client._sock.sendall(protocol.encode({"op": "status"}))
+        buf = b""
+        while buf.count(b"\n") < 2:
+            buf += client._sock.recv(65536)
+        first, second = buf.split(b"\n")[:2]
+        assert json.loads(first)["op"] == "error"
+        assert json.loads(second)["op"] == "status"
+    finally:
+        sched.stop()
+
+
+def test_top_renders_scheduler_row():
+    from distributed_drift_detection_tpu.telemetry.top import (
+        StatuszSource,
+        render,
+    )
+
+    src = StatuszSource("127.0.0.1:1")
+    row = src._sched_row(
+        {
+            "sched": True,
+            "run_id": "sched-x",
+            "uptime_s": 10.0,
+            "cells": {"total": 6, "queued": 2, "leased": 1,
+                      "completed": 2, "failed": 1},
+            "workers": [
+                {"worker": "w0", "alive": True, "rows_done": 500,
+                 "age_s": 0.5},
+                {"worker": "w1", "alive": False, "rows_done": 100,
+                 "age_s": 60.0},
+            ],
+            "evictions": 1,
+            "whole": False,
+        },
+        now_mono=time.monotonic(),
+    )
+    assert row["status"] == "sched"
+    assert row["rows"] == 600
+    assert "q:2 l:1 c:2 f:1 wk:1/2 ev:1" == row["wire"]
+    assert row["alerts"] == ["cells_failed"]
+    assert row["age_s"] == 0.5
+    assert "sched-x" in render([row], time.time())
+
+
+# --- the multi-process acceptance proof -------------------------------------
+
+
+@pytest.mark.slow
+def test_multiprocess_sweep_with_killed_workers_exactly_once(tmp_path):
+    """ISSUE 15 acceptance: a 12-cell grid, 3 worker subprocesses,
+    Bernoulli fault injection killing workers at random → the registry
+    converges to every cell completed exactly once, with result rows
+    bit-identical to a serial grid run."""
+    from distributed_drift_detection_tpu.harness.grid import run_grid
+    from distributed_drift_detection_tpu.metrics import RESULT_COLUMNS
+    from distributed_drift_detection_tpu.results import read_results
+
+    serial_csv = str(tmp_path / "serial.csv")
+    sched_csv = str(tmp_path / "sched.csv")
+    spec = sweep_spec(
+        "synth:rialto,seed=0", [1, 2, 4], [1, 2],
+        trials=2, per_batch=50, results_csv=sched_csv, spec="off",
+    )
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+
+    # Serial reference: the same 12 cells through run_grid, in-process.
+    base = RunConfig(
+        dataset="synth:rialto,seed=0", per_batch=50,
+        results_csv=serial_csv,
+    )
+    # Float mults, exactly as the grid CLI parses them — the spec
+    # expansion normalizes to float, and the trial key renders the raw
+    # value ("m1.0"), so an int here would rename every Spark App cell.
+    n = run_grid(
+        base, mults=[1.0, 2.0, 4.0], partitions=[1, 2], trials=2,
+        spec="off", progress=lambda _m: None,
+    )
+    assert n == 12
+
+    tele = str(tmp_path / "tele")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # Each worker dies (at most once) at a seeded-random cell; the
+        # agent re-seeds per --index, so deaths de-correlate, and the
+        # elastic respawn loop replaces the fallen.
+        "DDD_FAULTS": "sched.worker:rate=0.4,seed=11,times=1",
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "distributed_drift_detection_tpu",
+            "sched", str(spec_path), "--telemetry-dir", tele,
+            "--workers", "3", "--lease-s", "60", "--json",
+            "--timeout", "600",
+        ],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.splitlines()[-1])
+    assert summary["whole"] and summary["audit"]["ok"], summary
+    assert summary["completed"] == 12
+
+    # Registry audit, independently recomputed: exactly once per digest.
+    done = heal.completed_digests(tele)
+    assert sorted(done.values()) == [1] * 12, done
+
+    # Result rows bit-identical to the serial sweep (timing and
+    # start-stamp columns excluded — they are wall-clock, not results).
+    nondeterministic = {"Exp Start Time", "Final Time", "Rows Per Sec"}
+    keep = [c for c in RESULT_COLUMNS if c not in nondeterministic]
+
+    def projected(path):
+        return sorted(
+            tuple(str(r[c]) for c in keep) for r in read_results(path)
+        )
+
+    assert projected(sched_csv) == projected(serial_csv)

@@ -851,3 +851,101 @@ def test_feeder_ingest_counters():
     assert reg.counter("ingest_rows_total").values[()] == n
     assert reg.counter("ingest_chunks_total").values[()] == len(chunks)
     assert reg.counter("prefetch_chunks_total").values[()] == len(chunks)
+
+
+# --- registry compaction (ISSUE 15 satellite) -------------------------------
+
+
+def _populate_registry(tmp_path):
+    """A directory whose index carries history: a retried run, a sweep
+    bracket, and a plain completed run with a log file."""
+    from distributed_drift_detection_tpu.telemetry import registry
+
+    tele = str(tmp_path)
+    registry.record(tele, "r1", "running", config_digest="d1", log="r1.jsonl")
+    registry.record(tele, "r1", "failed")
+    registry.record(tele, "r1", "running", config_digest="d1", log="r1.jsonl")
+    registry.record(tele, "r1", "completed")
+    registry.record(tele, "sweep-1", "running", kind="sweep", trials_total=2)
+    registry.record(tele, "r2", "running", config_digest="d2", log="r2.jsonl")
+    registry.record(tele, "r2", "completed")
+    registry.record(tele, "sweep-1", "completed", kind="sweep")
+    (tmp_path / "r1.jsonl").write_text("")
+    (tmp_path / "r2.jsonl").write_text("")
+    return tele
+
+
+def test_registry_compaction_preserves_fold_semantics(tmp_path):
+    from distributed_drift_detection_tpu.telemetry import registry
+
+    tele = _populate_registry(tmp_path)
+    before_runs = registry.runs(tele)
+    before_newest = registry.newest_run_log(tele)
+    out = registry.compact_index(tele)
+    assert out == {"records_before": 8, "records_after": 3}
+    after = registry.read_index(tele)
+    assert len(after) == 3
+    after_runs = registry.runs(tele)
+    # Current state identical per run: status, digest, kind, log, start.
+    assert set(after_runs) == set(before_runs)
+    for rid, rec in before_runs.items():
+        for key in ("status", "config_digest", "kind", "log", "started_ts"):
+            assert after_runs[rid].get(key) == rec.get(key), (rid, key)
+    assert registry.newest_run_log(tele) == before_newest
+    # heal's digest diff sees the same completed multiset.
+    from distributed_drift_detection_tpu.resilience.heal import (
+        completed_digests,
+    )
+
+    assert completed_digests(tele) == {"d1": 1, "d2": 1}
+    # Compaction is idempotent.
+    out2 = registry.compact_index(tele)
+    assert out2 == {"records_before": 3, "records_after": 3}
+    # And appending after compaction keeps working (lock/reopen dance).
+    registry.record(tele, "r3", "running", config_digest="d3")
+    assert registry.runs(tele)["r3"]["status"] == "running"
+
+
+def test_registry_torn_compaction_leaves_index_intact(tmp_path):
+    """A compaction killed before its atomic replace leaves the old
+    index byte-identical and only a stray tmp file behind — which the
+    next compaction overwrites, and which no reader ever resolves."""
+    import os
+
+    from distributed_drift_detection_tpu.telemetry import registry
+
+    tele = _populate_registry(tmp_path)
+    raw = open(registry.index_path(tele), "rb").read()
+    # Simulate the torn compaction: the snapshot tmp exists (even torn
+    # mid-line), the replace never happened.
+    tmp = registry.index_path(tele) + f".compact-{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write('{"ts": 1, "run_id": "r1", "stat')  # torn mid-record
+    assert open(registry.index_path(tele), "rb").read() == raw
+    assert registry.read_index(tele)  # parses fine
+    assert registry.newest_run_log(tele) is not None  # tmp never a log
+    # The next compaction overwrites the stray tmp and succeeds.
+    out = registry.compact_index(tele)
+    assert out == {"records_before": 8, "records_after": 3}
+    assert not os.path.exists(tmp)
+
+
+def test_registry_maybe_compact_thresholds(tmp_path):
+    from distributed_drift_detection_tpu.telemetry import registry
+
+    tele = _populate_registry(tmp_path)  # 8 records
+    assert registry.maybe_compact(tele, max_records=0) is None
+    assert registry.maybe_compact(tele, max_records=8) is None
+    out = registry.maybe_compact(tele, max_records=7)
+    assert out == {"records_before": 8, "records_after": 3}
+    assert registry.maybe_compact(str(tmp_path / "absent"), max_records=1) is None
+
+
+def test_registry_compact_cli(tmp_path, capsys):
+    from distributed_drift_detection_tpu.telemetry import registry
+
+    tele = _populate_registry(tmp_path)
+    registry.main(["compact", tele, "--min-records", "100"])
+    assert "nothing to compact" in capsys.readouterr().out
+    registry.main(["compact", tele])
+    assert "compacted 8 → 3 records" in capsys.readouterr().out
