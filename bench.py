@@ -1047,7 +1047,16 @@ def _serve_stats(
     # agreement round over round.
     reg_p50 = hist_quantile(hist, 0.5, stage="total")
     reg_p99 = hist_quantile(hist, 0.99, stage="total")
+    # Serve-pipeline observatory rider (telemetry.pipeline): the drained
+    # daemon's per-stage busy split — the chunked rider's `pipeline_s`
+    # twin — plus busy/wall coverage, the perf CLI's gated honesty cell
+    # (instrumentation losing track of where the loop's time goes reads
+    # as a regression, exactly like a throughput drop would).
+    pipe = runner.pipeline_snapshot() or {}
     return {
+        "serve_pipeline_s": pipe.get("busy_s") or None,
+        "serve_busy_utilization": pipe.get("coverage"),
+        "serve_dominant_stage": pipe.get("dominant_stage"),
         "serve_rows": rep["rows_sent"],
         "serve_tenants": cfg.tenants,
         "serve_rows_per_sec": rep["achieved_rows_per_sec"],

@@ -64,6 +64,7 @@ runs experiments):
     python -m distributed_drift_detection_tpu perf BENCH_r*.json [...]
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
     python -m distributed_drift_detection_tpu top <run.jsonl | DIR>... [--statusz URL]
+    python -m distributed_drift_detection_tpu pipeline <.prom | run.jsonl | URL>
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
     python -m distributed_drift_detection_tpu timeline <DIR | logs...> [-o OUT]
     python -m distributed_drift_detection_tpu explain <DIR | run.jsonl | bundle>
@@ -81,7 +82,11 @@ heartbeats, exit 3 past ``--stall-after`` (telemetry.watch, the
 scriptable health check); ``top`` renders one refreshing dashboard
 over many runs — throughput, latency percentiles, drift/quarantine
 rates, active alerts — from tailed logs and/or serving daemons'
-``--ops-port`` ``/statusz`` endpoints (telemetry.top); ``correlate`` merges a multi-host run's
+``--ops-port`` ``/statusz`` endpoints (telemetry.top); ``pipeline``
+renders the serve-pipeline observatory — per-stage busy share,
+utilization, implied rows/s ceiling and the dominant (bottleneck)
+stage — from a metrics export or a live daemon
+(telemetry.pipeline); ``correlate`` merges a multi-host run's
 per-process logs into one timeline with straggler diagnostics
 (telemetry.correlate); ``heal`` diffs a sweep spec against the
 registry's completed runs and emits — or ``--execute``s under the
@@ -120,6 +125,7 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu perf BENCH_JSON [...]\n"
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
     "       python -m distributed_drift_detection_tpu top DIR_OR_LOGS [--statusz URL]\n"
+    "       python -m distributed_drift_detection_tpu pipeline PROM_OR_LOG_OR_URL [--json]\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
     "       python -m distributed_drift_detection_tpu timeline DIR_OR_LOGS [-o OUT]\n"
     "       python -m distributed_drift_detection_tpu explain DIR_OR_LOG_OR_BUNDLE\n"
@@ -173,6 +179,12 @@ def main(argv: list[str]) -> None:
 
         top_main(argv[1:])
         return
+    if argv and argv[0] == "pipeline":
+        # jax-free: the serve-pipeline bottleneck report reads a .prom /
+        # .metrics.json export, a run-log sibling, or a live /statusz.
+        from .telemetry.pipeline import main as pipeline_main
+
+        raise SystemExit(pipeline_main(argv[1:]))
     if argv and argv[0] == "correlate":
         # jax-free: multi-host logs are merged wherever they are mirrored.
         from .telemetry.correlate import main as correlate_main

@@ -351,6 +351,13 @@ class ServeParams(NamedTuple):
     # exception the last N run-log events dump to
     # `<run-log>.flightrec.jsonl`; a clean drain leaves no dump. 0 = off.
     flightrec_events: int = 256
+    # Serve-pipeline observatory (telemetry.pipeline): per-stage busy
+    # accounting (serve_stage_busy_seconds_total), the /statusz
+    # `pipeline` section, and per-chunk stage spans. Stamps are cheap
+    # monotonic reads folded in outside the hot dispatch; False turns
+    # the accounting off entirely (the CLI's --no-pipeline-metrics) —
+    # verdict sidecars are bit-identical either way.
+    pipeline_metrics: bool = True
     # --- trace plane (telemetry.tracing / .forensics) ---
     # Daemon-side head-sampling rate for rows the client did NOT stamp
     # with a TRACE wire line: each sampled row gets a fresh root trace

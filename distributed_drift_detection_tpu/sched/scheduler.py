@@ -263,6 +263,7 @@ class Scheduler:
                 metrics_fn=self._metrics_text,
                 health_fn=self._health,
                 status_fn=self.status,
+                fleetz_fn=self.fleetz,
             )
             self._ops.start()
         self._sel.register(self._listen, selectors.EVENT_READ, None)
@@ -354,6 +355,8 @@ class Scheduler:
     # -- ops plane -------------------------------------------------------
 
     def _metrics_text(self) -> "str | None":
+        from ..telemetry.pipeline import fleet_metrics_lines
+
         with self._lock:
             counts = self.queue.counts()
             # Under the lock: the select-loop thread mutates self.workers
@@ -364,7 +367,33 @@ class Scheduler:
         self._g_leased.set(counts["leased"])
         self._g_workers.set(alive)
         self._g_rate.set(self.cells_per_sec() or 0.0)
-        return self._metrics.to_prometheus_text()
+        # the fleet_* series ride the scheduler's scrape too, so one
+        # Prometheus target covers queue state AND per-worker load
+        fleet = "\n".join(fleet_metrics_lines(self.fleetz())) + "\n"
+        return self._metrics.to_prometheus_text() + fleet
+
+    def fleetz(self) -> dict:
+        """The merged fleet view (``/fleetz``), shaped like the tenant
+        router's. Workers expose no ops endpoints — the scheduler IS
+        their state plane — so each snapshot comes from the lease
+        registry's worker accounting: cumulative rows and the average
+        rows/s since the worker joined."""
+        from ..telemetry.pipeline import aggregate_fleet
+
+        now = self._clock()
+        with self._lock:
+            snaps = [
+                {
+                    "name": w.worker,
+                    "alive": w.alive,
+                    "rows": w.rows_done,
+                    "rows_per_sec": round(
+                        w.rows_done / max(now - w.joined_mono, 1e-9), 3
+                    ),
+                }
+                for w in self.workers.values()
+            ]
+        return aggregate_fleet(snaps)
 
     def _health(self) -> "tuple[int, dict]":
         with self._lock:

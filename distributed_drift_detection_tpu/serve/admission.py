@@ -183,6 +183,7 @@ class MicroBatcher:
         self.start_row = int(start_row)  # next chunk's grid position
         self.chunk_index = int(chunk_index)
         self.rows_admitted = int(rows_admitted)  # cumulative, incl. masked
+        self.rows_sealed = 0  # cumulative rows sealed into chunks (this process)
         self._max_queue = max(1, max_queue)
         self._cv = threading.Condition()
         self._X: list[np.ndarray] = []
@@ -274,6 +275,7 @@ class MicroBatcher:
             return {
                 "queued_chunks": len(self._queue),
                 "buffered_rows": self._buffered,
+                "rows_sealed": self.rows_sealed,
             }
 
     def tenant_state(self, tenant: int = 0) -> dict:
@@ -396,6 +398,7 @@ class MicroBatcher:
                     for m in taken
                 ]
         self._queue.append(SealedChunk(chunk, meta))
+        self.rows_sealed += int(n_take)
         # Grid-slot semantics: the stream position always advances by the
         # full grid span, so the next seal stays aligned to P·B (the
         # stripe-time shuffle's invariance requirement) and a short flush
@@ -522,6 +525,7 @@ class TenantMicroBatcher:
                 "tenants"
             )
         self.tenant_rows_admitted = [int(r) for r in per_tenant_admitted]
+        self.rows_sealed = 0  # cumulative rows sealed into chunks (this process)
         self._max_buffer_spans = int(max_buffer_spans)
         self._max_queue = max(1, max_queue)
         self._cv = threading.Condition()
@@ -629,6 +633,7 @@ class TenantMicroBatcher:
                 "queued_chunks": len(self._queue),
                 "buffered_rows": sum(self._buffered),
                 "tenant_buffered_rows": list(self._buffered),
+                "rows_sealed": self.rows_sealed,
             }
 
     def tenant_state(self, tenant: int) -> dict:
@@ -781,6 +786,7 @@ class TenantMicroBatcher:
         if traces:
             meta["traces"] = traces
         self._queue.append(SealedChunk(chunk, meta))
+        self.rows_sealed += int(sum(t_rows))
         self.chunk_index += 1
         self._first_ts = time.monotonic() if any(self._buffered) else None
 

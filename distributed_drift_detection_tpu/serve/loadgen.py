@@ -396,6 +396,35 @@ def _send_frames(
     return send_ts
 
 
+def _stage_split(covering: "list[dict]") -> "dict | None":
+    """Row→verdict latency split by serve stage, joined from the
+    covering verdict records' own stage stamps (``record['lat_ms']``,
+    written by the serve runner for every chunk): per-component
+    p50/p99 ms over the covered rows, each row weighted by its
+    covering record. ``None`` when no record carries stamps (a
+    pre-observatory daemon's sidecar) — the summary stays
+    end-to-end-only there, exactly as before. This makes client-side
+    attribution cross-checkable against the daemon's busy accounting:
+    the dominant component here should name the same stage the
+    ``pipeline`` report blames."""
+    stages: "dict[str, list[float]]" = {}
+    for r in covering:
+        lm = r.get("lat_ms")
+        if not lm:
+            continue
+        for k, v in lm.items():
+            stages.setdefault(k, []).append(float(v))
+    if not stages:
+        return None
+    return {
+        k: {
+            "p50": round(float(np.percentile(v, 50)), 3),
+            "p99": round(float(np.percentile(v, 99)), 3),
+        }
+        for k, v in sorted(stages.items())
+    }
+
+
 def _run_loadgen_tenants(
     host: str,
     port: int,
@@ -546,19 +575,20 @@ def _run_loadgen_tenants(
     lat_ms: list[float] = []
     per_tenant_covered = [0] * tenants
     verdict_ts: dict[int, float] = {}
+    covering: list[dict] = []  # one record per covered row (stage split)
     if records:
         for t in range(tenants):
             entries = [
-                (int(e["rows_through"]), float(r["ts"]))
+                (int(e["rows_through"]), float(r["ts"]), r)
                 for r in records
                 for e in (r.get("tenants") or [])
                 if _key(e) == t
             ]
             if not entries or not streams[t]:
                 continue
-            entries.sort()
-            throughs = np.array([x for x, _ in entries])
-            ts = np.array([x for _, x in entries])
+            entries.sort(key=lambda x: x[:2])
+            throughs = np.array([x for x, _, _ in entries])
+            ts = np.array([x for _, x, _ in entries])
             pos = baselines[t] + np.arange(len(streams[t]))
             idx = np.searchsorted(throughs, pos, side="right")
             ok = idx < len(entries)
@@ -567,6 +597,7 @@ def _run_loadgen_tenants(
             lat_ms.extend(
                 ((ts[idx[ok]] - send_ts[row_ids]) * 1000.0).tolist()
             )
+            covering.extend(entries[i][2] for i in idx[ok])
             if trace_ctx:
                 for rid, vts in zip(row_ids, ts[idx[ok]]):
                     if int(rid) in trace_ctx:
@@ -593,6 +624,7 @@ def _run_loadgen_tenants(
             round(float(np.percentile(lat_ms, 99)), 2) if lat_ms else None
         ),
         "mean_ms": round(float(np.mean(lat_ms)), 2) if lat_ms else None,
+        "stage_ms": _stage_split(covering),
         "timeout": timed_out,
     }
 
@@ -717,6 +749,7 @@ def run_loadgen(
             time.sleep(0.02)
     lat_ms: list[float] = []
     verdict_ts: dict[int, float] = {}
+    covering: list[dict] = []  # one record per covered row (stage split)
     if records:
         recs = sorted(records, key=lambda r: int(r["rows_through"]))
         throughs = np.array([int(r["rows_through"]) for r in recs])
@@ -725,6 +758,7 @@ def run_loadgen(
         idx = np.searchsorted(throughs, pos, side="right")
         ok = idx < len(recs)
         lat_ms = ((ts[idx[ok]] - send_ts[ok]) * 1000.0).tolist()
+        covering = [recs[i] for i in idx[ok]]
         if trace_ctx:
             covered_rows = np.nonzero(ok)[0]
             for rid, vts in zip(covered_rows, ts[idx[ok]]):
@@ -748,6 +782,9 @@ def run_loadgen(
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if lat_ms else None,
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if lat_ms else None,
         "mean_ms": round(float(np.mean(lat_ms)), 2) if lat_ms else None,
+        # daemon-stamped stage split of the same covered rows — the
+        # end-to-end percentiles above, attributed
+        "stage_ms": _stage_split(covering),
         "timeout": timed_out,
     }
     return report

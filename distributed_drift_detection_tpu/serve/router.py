@@ -204,6 +204,11 @@ class _Backend:
         with urllib.request.urlopen(url, timeout=timeout) as r:
             return json.load(r)
 
+    def metrics_text(self, timeout: float = 5.0) -> str:
+        url = f"http://{self.spec.host}:{self.spec.ops_port}/metrics"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+
     def healthz(self, timeout: float = 2.0) -> bool:
         """True while the daemon ANSWERS — 200 and 503 both mean alive
         (503 is an SLO alert, the daemon's own problem); only a dead
@@ -1303,6 +1308,34 @@ class TenantRouter:
             "placements": placements,
         }
 
+    def fleetz(self) -> dict:
+        """The merged fleet view (``/fleetz``): scrape every live
+        backend's ``/statusz`` (falling back to its ``/metrics`` for
+        the busy map when the pipeline section is absent) and fold
+        into summed rows/s, max per-stage busy share, and per-backend
+        bottleneck stages. Computed on request, outside the router
+        lock — a slow backend stalls the scrape, never the data path."""
+        from ..telemetry.pipeline import aggregate_fleet, backend_snapshot
+
+        with self._lock:
+            backends = list(self.backends)
+        snaps = []
+        for b in backends:
+            status = metrics = None
+            if b.alive:
+                try:
+                    status = b.statusz(timeout=2.0)
+                    if not (status.get("pipeline") or {}).get("busy_s"):
+                        metrics = b.metrics_text(timeout=2.0)
+                except (urllib.error.URLError, OSError, ValueError):
+                    status = metrics = None
+            snaps.append(
+                backend_snapshot(
+                    b.name or repr(b.spec), status, metrics
+                )
+            )
+        return aggregate_fleet(snaps)
+
     def _health(self) -> "tuple[int, dict]":
         with self._lock:
             alive = [b.name for b in self.backends if b.alive]
@@ -1330,11 +1363,18 @@ class TenantRouter:
             metrics_fn=self._metrics_text,
             health_fn=self._health,
             status_fn=self.status,
+            fleetz_fn=self.fleetz,
         )
         ops.start()
         return ops
 
     def _metrics_text(self) -> str:
+        from ..telemetry.pipeline import fleet_metrics_lines
+
+        # fleet_* series ride the router's scrape: aggregate first
+        # (its own backend scrapes), THEN take the lock for the
+        # router-local counters.
+        fleet_lines = fleet_metrics_lines(self.fleetz())
         with self._lock:
             lines = [
                 "# TYPE router_rows_forwarded_total counter",
@@ -1354,7 +1394,7 @@ class TenantRouter:
                 "# TYPE router_rows_lost_total counter",
                 f"router_rows_lost_total {self.rows_lost}",
             ]
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines + fleet_lines) + "\n"
 
 
 class _Reject(Exception):

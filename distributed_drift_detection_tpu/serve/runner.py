@@ -210,6 +210,14 @@ class ServeRunner:
         self._compile_info: dict = {}
         self._last_pub_mono: "float | None" = None
         self._loop_mono: "float | None" = None  # serve-loop liveness stamp
+        # Pipeline observatory (telemetry.pipeline): stage busy clock,
+        # wall/rows gauges, per-chunk stage-span tracer. All None when
+        # params.pipeline_metrics is off — every touch point guards.
+        self._stage_clock = None
+        self._wall_gauge = None
+        self._rows_gauge = None
+        self._chunk_tracer = None
+        self._loop_start_mono: "float | None" = None
         self._inflight_n = 0
         self._verdict_fh = None
         self.verdicts_path: "str | None" = None
@@ -269,6 +277,22 @@ class ServeRunner:
         # requires a telemetry dir.
         self._metrics = MetricsRegistry()
         self._lat_hist = trace.latency_histogram(self._metrics)
+        if params.pipeline_metrics:
+            from ..telemetry.pipeline import (
+                SERVE_ROWS_HELP,
+                SERVE_ROWS_METRIC,
+                SERVE_WALL_HELP,
+                SERVE_WALL_METRIC,
+                ServeStageClock,
+            )
+
+            self._stage_clock = ServeStageClock(self._metrics)
+            self._wall_gauge = self._metrics.gauge(
+                SERVE_WALL_METRIC, help=SERVE_WALL_HELP
+            )
+            self._rows_gauge = self._metrics.gauge(
+                SERVE_ROWS_METRIC, help=SERVE_ROWS_HELP
+            )
         if params.flightrec_events > 0:
             self._recorder = FlightRecorder(params.flightrec_events)
         ident = None
@@ -352,6 +376,22 @@ class ServeRunner:
             from ..telemetry.tracing import HeadSampler
 
             self._sampler = HeadSampler(params.trace_sample, seed=cfg.seed)
+        if (
+            params.pipeline_metrics
+            and params.trace_sample > 0
+            and self._log is not None
+        ):
+            # Per-chunk stage spans on the trace plane: each sampled
+            # chunk's feed/device/collect/publish windows share one
+            # trace, laid out next to the row-level serving spans.
+            from ..telemetry.tracing import ChunkTracer
+
+            # seed offset: the row-tracing sampler above seeds its rng
+            # with cfg.seed too — the same stream would mint identical
+            # trace ids, welding chunk spans onto row traces
+            self._chunk_tracer = ChunkTracer(
+                self._log, params.trace_sample, seed=cfg.seed + 0x5EED
+            )
         if params.forensics and self._log is not None:
             from ..telemetry.forensics import (
                 FORENSICS_SUFFIX,
@@ -831,7 +871,42 @@ class ServeRunner:
             "alerts": alerts,
             "poisoned": None if poisoned is None else repr(poisoned),
         }
+        if any(a.get("rule") in ("stall_s", "p99_ms") for a in alerts):
+            # A wedged/slow loop names its dominant stage right in the
+            # health body — the one-curl diagnosis the observatory owes.
+            snap = self.pipeline_snapshot()
+            if snap is not None and snap.get("dominant_stage"):
+                payload["bottleneck_stage"] = snap["dominant_stage"]
         return (200 if healthy else 503), payload
+
+    def pipeline_snapshot(self) -> "dict | None":
+        """The ``/statusz`` ``pipeline`` section (also bench's
+        ``serve_pipeline_s`` source): per-stage busy seconds + shares
+        since the loop started, serve-loop wall, coverage (busy/wall),
+        and the named dominant stage. ``None`` when the observatory is
+        off (``--no-pipeline-metrics``). The busy dict is copied BEFORE
+        the wall stamp, so busy-sum ≤ wall holds even against the live
+        loop thread."""
+        if self._stage_clock is None:
+            return None
+        from ..telemetry.pipeline import attribute
+
+        busy = dict(self._stage_clock.busy)
+        wall = (
+            time.monotonic() - self._loop_start_mono
+            if self._loop_start_mono is not None
+            else 0.0
+        )
+        attr = attribute(busy, wall, self._rows_published)
+        return {
+            "busy_s": {s: round(t, 4) for s, t in sorted(busy.items())},
+            "wall_s": round(wall, 4),
+            "shares": {
+                s: c["share"] for s, c in attr["stages"].items()
+            },
+            "coverage": attr.get("coverage"),
+            "dominant_stage": attr["dominant_stage"],
+        }
 
     def _statusz(self) -> dict:
         """The ``/statusz`` snapshot (one JSON dict, cheap to assemble)."""
@@ -887,6 +962,12 @@ class ServeRunner:
             "ingress": (
                 self._ingress.stats() if self._ingress is not None else None
             ),
+            "rows_per_sec": (
+                round(self._rows_published / (now - self._t_start), 3)
+                if self._t_start is not None and now > self._t_start
+                else 0.0
+            ),
+            "pipeline": self.pipeline_snapshot(),
             "detections": self._detections,
             "last_verdict_age_s": (
                 None
@@ -936,12 +1017,21 @@ class ServeRunner:
         try:
             while True:
                 self._loop_mono = time.monotonic()  # SLO stall_s stamp
+                if self._loop_start_mono is None:
+                    self._loop_start_mono = self._loop_mono
                 if self._stop.is_set() and not stop_handled:
                     stop_handled = True
                     if self._ingress is not None:
                         self._ingress.stop()
                     self.batcher.flush()
+                wait_start = time.monotonic()
                 item = self.batcher.get(0.0 if inflight else params.poll_s)
+                if self._stage_clock is not None:
+                    # seal_wait = the loop blocked for input; folding it
+                    # here (not at publish) keeps an idle loop honest.
+                    now = time.monotonic()
+                    self._stage_clock.add("seal_wait", now - wait_start)
+                    self._wall_gauge.set(now - self._loop_start_mono)
                 if item is not None:
                     # Forensics: copy the detector state ENTERING this
                     # chunk before the feed donates the carry (an async
@@ -949,10 +1039,18 @@ class ServeRunner:
                     # host-side at publish, when the chunk's compute is
                     # done anyway). None when forensics is off.
                     entry = self._capture_entry()
+                    feed_start = time.monotonic()
                     flags = self.det.feed(self.det.place(item.chunk))
                     # Row-tracing stamp: the chunk entered the device
                     # pipeline (queue stage ends, device stage begins).
                     item.meta["fed_mono"] = time.monotonic()
+                    if self._stage_clock is not None:
+                        # feed = place()+feed() dispatch (h2d + enqueue;
+                        # the device wait is accounted at publish)
+                        item.meta["_feed_start_mono"] = feed_start
+                        self._stage_clock.add(
+                            "feed", item.meta["fed_mono"] - feed_start
+                        )
                     inflight.append(
                         (
                             flags,
@@ -1031,6 +1129,7 @@ class ServeRunner:
         (the row→verdict latency endpoint)."""
         import jax
 
+        pub_start = time.monotonic()  # loop blocks on the device sync here
         host = jax.tree.map(np.asarray, flags)
         collected_mono = time.monotonic()  # device stage ends here
         cg = np.asarray(host.change_global)
@@ -1098,6 +1197,25 @@ class ServeRunner:
         if trace_marks:
             # the sidecar verdict joins back to its originating packets
             record["traces"] = [m["trace_id"] for m in trace_marks]
+        assembled_mono = time.monotonic()  # collect stage ends here
+        # Per-chunk latency split (admission/queue/device/collect), from
+        # the stamps every seal already carries — present in BOTH
+        # pipeline-metrics modes, so the sidecar schema never depends on
+        # the instrumentation flag (bit-parity modulo ts/lat_ms). The
+        # loadgen summary joins these to split client-observed latency.
+        fed_m = meta.get("fed_mono", collected_mono)
+        sealed_m = meta.get("sealed_mono", fed_m)
+        lat = {
+            "queue": fed_m - sealed_m,
+            "device": collected_mono - fed_m,
+            "collect": assembled_mono - collected_mono,
+        }
+        ing = meta.get("ingest_mono")
+        if ing is not None and len(ing):
+            lat = {"admission": sealed_m - float(np.mean(ing)), **lat}
+        record["lat_ms"] = {
+            k: round(max(v, 0.0) * 1000.0, 3) for k, v in lat.items()
+        }
         line = json.dumps(record)
         # Fault-injection site (resilience.faults; no-op unless armed):
         # raise = die after the chunk's state advanced but before its
@@ -1144,6 +1262,7 @@ class ServeRunner:
                 published_mono=published_mono,
             )
             self._rows_traced += len(trace_ids)
+        hooks_start = time.monotonic()  # publish stage ends here
         if self._forensics is not None and chunk is not None:
             entry_host = (
                 jax.tree.map(np.asarray, entry) if entry is not None else None
@@ -1156,10 +1275,12 @@ class ServeRunner:
                 log=self._log,
                 trace_ids=trace_ids,
             )
+        forensics_done = time.monotonic()
         if self._adapt is not None:
             # the reaction arm: route this verdict through the per-tenant
             # policy — forensics above explains the drift, this acts on it
             self._adapt.on_chunk(meta, host, chunk)
+        adapt_done = time.monotonic()
         if self._log is not None:
             from ..telemetry.events import emit_flag_events
 
@@ -1168,6 +1289,40 @@ class ServeRunner:
             )
             self.det.emit_heartbeat(self._log)
             emit_flag_events(self._log, cg, np.asarray(host.forced_retrain), 0)
+        if self._stage_clock is not None:
+            # Fold the whole chunk's stage timings in one place, outside
+            # the dispatch window: `device` is the loop BLOCKED on the
+            # host sync (the pipelined overlap already subtracted —
+            # busy-conservation needs loop-thread time, not device time).
+            clk = self._stage_clock
+            clk.add("device", collected_mono - pub_start)
+            clk.add("collect", assembled_mono - collected_mono)
+            clk.add("publish", hooks_start - assembled_mono)
+            clk.add("forensics", forensics_done - hooks_start)
+            clk.add("adapt", adapt_done - forensics_done)
+            self._rows_gauge.set(self._rows_published)
+            if self._loop_start_mono is not None:
+                self._wall_gauge.set(
+                    time.monotonic() - self._loop_start_mono
+                )
+        if self._chunk_tracer:
+            # Stage spans ride the trace plane per sampled chunk; the
+            # device span is the TRUE device window (fed→collected),
+            # which overlaps the next chunk's feed at depth 2.
+            ck = meta["chunk"]
+            fs = meta.get("_feed_start_mono")
+            fed_span = meta.get("fed_mono", pub_start)
+            if fs is not None:
+                self._chunk_tracer.span("serve.feed", ck, fs, fed_span)
+            self._chunk_tracer.span(
+                "serve.device", ck, fed_span, collected_mono
+            )
+            self._chunk_tracer.span(
+                "serve.collect", ck, collected_mono, assembled_mono
+            )
+            self._chunk_tracer.span(
+                "serve.publish", ck, assembled_mono, published_mono
+            )
 
     def _save_checkpoint(self) -> None:
         if self.det.carry is None or self._last_meta is None:
@@ -1414,6 +1569,11 @@ def main(argv=None) -> None:
                     help="SLO evaluator cadence (its own thread)")
     ap.add_argument("--flightrec-events", type=int, default=256,
                     help="crash flight-recorder ring capacity (0 = off)")
+    ap.add_argument("--no-pipeline-metrics", action="store_true",
+                    help="disable the serve-pipeline observatory "
+                    "(stage busy counters, /statusz pipeline section, "
+                    "per-chunk stage spans); verdict sidecars are "
+                    "bit-identical either way")
     ap.add_argument("--trace-sample", type=float, default=0.0,
                     help="daemon-side head-sampling rate (0..1) for rows "
                     "the client did not TRACE-stamp: sampled rows get the "
@@ -1501,6 +1661,7 @@ def main(argv=None) -> None:
         slo_interval_s=args.slo_interval_s,
         flightrec_events=args.flightrec_events,
         trace_sample=args.trace_sample,
+        pipeline_metrics=not args.no_pipeline_metrics,
         forensics=not args.no_forensics,
         on_drift=tuple(args.on_drift),
     )

@@ -18,7 +18,13 @@ ingress):
                the reasons
 ``/statusz``   one JSON snapshot of the daemon: run id, row/chunk
                accounting, queue depth, AOT/compile-cache state, live
-               latency percentiles, last-verdict age, active alerts
+               latency percentiles, last-verdict age, active alerts,
+               and the serve-pipeline section (stage busy shares +
+               dominant stage)
+``/fleetz``    aggregators only (``fleetz_fn``; the tenant router and
+               sweep scheduler): the merged fleet view — summed rows/s,
+               max per-stage busy share, per-backend bottleneck. A
+               plain daemon 404s here.
 =============  ==========================================================
 
 Handlers never *write* daemon state: the server is constructed with
@@ -136,6 +142,13 @@ class _OpsHandler(BaseHTTPRequestHandler):
                     json.dumps(self.server.status_fn(), indent=1) + "\n"
                 ).encode()
                 code, ctype = 200, "application/json"
+            elif path == "/fleetz" and self.server.fleetz_fn is not None:
+                # aggregators only (router/scheduler): the merged fleet
+                # view; a plain daemon keeps 404-ing here
+                body = (
+                    json.dumps(self.server.fleetz_fn(), indent=1) + "\n"
+                ).encode()
+                code, ctype = 200, "application/json"
             else:
                 body = b'{"error": "not found"}\n'
                 code, ctype = 404, "application/json"
@@ -167,11 +180,24 @@ class OpsServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, host: str, port: int, *, metrics_fn, health_fn, status_fn):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        metrics_fn,
+        health_fn,
+        status_fn,
+        fleetz_fn=None,
+    ):
         super().__init__((host, port), _OpsHandler)
         self._metrics_fn = metrics_fn
         self.health_fn = health_fn
         self.status_fn = status_fn
+        # Optional merged fleet view (``/fleetz``): set by aggregators
+        # (the tenant router, the sweep scheduler); None = 404, so a
+        # plain daemon's ops surface is unchanged.
+        self.fleetz_fn = fleetz_fn
         self._thread: "threading.Thread | None" = None
 
     @property

@@ -121,6 +121,23 @@ CELLS = (
     ("serve_p99_ms", _DOWN, True, "ms"),
     ("serve_registry_p50_ms", _DOWN, False, "ms"),
     ("serve_registry_p99_ms", _DOWN, False, "ms"),
+    # Serve-pipeline observatory (bench.py --serve rider, r16+): the
+    # serve loop's per-stage busy split (serve_pipeline_s dict →
+    # serve_stage_*_s cells) prints informationally — absolute stage
+    # seconds move with host load and the replay rate. GATED is
+    # serve_busy_utilization = stage-busy sum / serve-loop wall: the
+    # instrumentation-honesty claim (~1.0 on a single-threaded loop).
+    # A drop means the observatory lost track of where the loop's
+    # time goes — a code property, exactly what the bottleneck report
+    # depends on. Stall-aware via the serve_* suspect markers.
+    ("serve_busy_utilization", _UP, True, ""),
+    ("serve_stage_seal_wait_s", _DOWN, False, "s"),
+    ("serve_stage_feed_s", _DOWN, False, "s"),
+    ("serve_stage_device_s", _DOWN, False, "s"),
+    ("serve_stage_collect_s", _DOWN, False, "s"),
+    ("serve_stage_publish_s", _DOWN, False, "s"),
+    ("serve_stage_forensics_s", _DOWN, False, "s"),
+    ("serve_stage_adapt_s", _DOWN, False, "s"),
     # Serve-ingress admission rate (bench.py --serve ingest rider, r13+):
     # v2 binary frames through the real loopback socket → event-loop
     # ingress → vectorized frame admission → pooled-striper seals, with
@@ -214,6 +231,7 @@ SUMMARY_KEYS = tuple(c for c, _, _, _ in CELLS) + (
     "phase_median_s",
     "cold_vs_warm_compile_s",
     "chunked_pipeline_s",
+    "serve_pipeline_s",
     "xla",
 )
 
@@ -221,6 +239,7 @@ SUMMARY_KEYS = tuple(c for c, _, _, _ in CELLS) + (
 #: cells first) until it fits — the gated scalars always survive.
 _SUMMARY_DROP_ORDER = (
     "xla",
+    "serve_pipeline_s",
     "chunked_pipeline_s",
     "phase_median_s",
     "cold_vs_warm_compile_s",
@@ -438,6 +457,7 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "serve_p99_ms",
         "serve_registry_p50_ms",
         "serve_registry_p99_ms",
+        "serve_busy_utilization",
         "serve_ingest_rows_per_sec",
         "serve_ingest_mb_per_sec",
         "fleet_agg_rows_per_sec",
@@ -460,6 +480,21 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
             cells[f"chunked_stage_{name}_s"] = float(pipe[name])
     if pipe.get("feed_wait") is not None:
         cells["chunked_feed_wait_s"] = float(pipe["feed_wait"])
+    # Per-stage busy breakdown of the serve loop (r16+): bench's
+    # --serve rider records `serve_pipeline_s` as a dict (the chunked
+    # rider's twin; stage names from telemetry.pipeline.SERVE_STAGES).
+    spipe = bench.get("serve_pipeline_s") or {}
+    for name in (
+        "seal_wait",
+        "feed",
+        "device",
+        "collect",
+        "publish",
+        "forensics",
+        "adapt",
+    ):
+        if spipe.get(name) is not None:
+            cells[f"serve_stage_{name}_s"] = float(spipe[name])
     cvw = bench.get("cold_vs_warm_compile_s") or {}
     for src, dst in (
         ("cold_s", "compile_cold_s"),

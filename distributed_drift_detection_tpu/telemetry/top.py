@@ -90,6 +90,7 @@ class LogSource:
             "detections": s.detections,
             "quarantined": self.quarantined,
             "wire": None,  # per-protocol counters live on /statusz only
+            "busy": None,  # pipeline shares live on /statusz only
             "alerts": sorted(s.alerts),
             "age_s": age,
         }
@@ -120,6 +121,7 @@ class StatuszSource:
                 "detections": None,
                 "quarantined": None,
                 "wire": None,
+                "busy": None,
                 "alerts": [f"unreachable: {getattr(e, 'reason', e)}"],
                 "age_s": None,
             }
@@ -143,6 +145,11 @@ class StatuszSource:
             if ingress.get("decode_errors"):
                 wire += f" err:{ingress['decode_errors']}"
         status = "draining" if s.get("draining") else "live"
+        # BUSY: the serve-pipeline observatory's dominant stage + its
+        # busy share ("device:62%") from the /statusz pipeline section;
+        # absent ("-") under --no-pipeline-metrics or on old daemons.
+        busy = _busy_cell(s.get("pipeline") or {})
+        fleet_rows: list = []
         if s.get("router"):
             # A tenant router's /statusz (serve.router): the row reads
             # like a daemon serving the whole fleet, with the fleet
@@ -158,7 +165,10 @@ class StatuszSource:
             if s.get("rows_lost"):
                 fleet += f" lost:{s['rows_lost']}"
             wire = f"{wire} {fleet}" if wire else fleet
-        return {
+            # the merged fleet view: one indented row per backend with
+            # its own BUSY cell, then one fleet-aggregate row
+            fleet_rows = self._fleet_rows()
+        row = {
             "run": s.get("run_id") or self.url,
             "status": status,
             "rows": rows,
@@ -168,9 +178,56 @@ class StatuszSource:
             "detections": s.get("detections"),
             "quarantined": (s.get("rows") or {}).get("quarantined"),
             "wire": wire,
+            "busy": busy,
             "alerts": sorted(a["rule"] for a in s.get("alerts") or []),
             "age_s": s.get("last_verdict_age_s"),
         }
+        return [row, *fleet_rows] if fleet_rows else row
+
+    def _fleet_rows(self) -> list[dict]:
+        """Per-backend + fleet-aggregate dashboard rows from an
+        aggregator's ``/fleetz`` (missing endpoint = no extra rows —
+        a pre-observatory router renders exactly as before)."""
+        url = self.url[: -len("/statusz")] + "/fleetz"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                fz = json.load(r)
+        except (urllib.error.URLError, OSError, ValueError):
+            return []
+        rows = []
+        for b in fz.get("backends") or []:
+            share = b.get("busy_share") or {}
+            dom = b.get("bottleneck")
+            rows.append(
+                {
+                    "run": "  " + (b.get("name") or "?"),
+                    "status": "live" if b.get("alive") else "down",
+                    "rows": b.get("rows"),
+                    "rows_per_sec": b.get("rows_per_sec"),
+                    "busy": (
+                        _share_cell(dom, share.get(dom)) if dom else None
+                    ),
+                }
+            )
+        fleet = fz.get("fleet") or {}
+        shares = fleet.get("stage_busy_share_max") or {}
+        busy = None
+        if shares:
+            stage = max(sorted(shares), key=lambda k: shares[k]["share"])
+            busy = _share_cell(stage, shares[stage]["share"])
+        rows.append(
+            {
+                "run": (
+                    f"  fleet ({fleet.get('alive', 0)}/"
+                    f"{fleet.get('backends', 0)})"
+                ),
+                "status": "fleet",
+                "rows": fleet.get("rows"),
+                "rows_per_sec": fleet.get("rows_per_sec"),
+                "busy": busy,
+            }
+        )
+        return rows
 
     def _sched_row(self, s: dict, now_mono: float) -> dict:
         """A sweep scheduler's ``/statusz`` (sched/scheduler.py): the row
@@ -216,6 +273,20 @@ class StatuszSource:
         }
 
 
+def _share_cell(stage: str, share) -> str:
+    """"device:62%" — a stage plus its busy share, the BUSY cell."""
+    if share is None:
+        return stage
+    return f"{stage}:{share * 100:.0f}%"
+
+
+def _busy_cell(pipe: dict) -> "str | None":
+    dom = pipe.get("dominant_stage")
+    if not dom:
+        return None
+    return _share_cell(dom, (pipe.get("shares") or {}).get(dom))
+
+
 _COLUMNS = (
     ("RUN", "run", 38),
     ("ST", "status", 8),
@@ -226,6 +297,7 @@ _COLUMNS = (
     ("DET", "detections", 7),
     ("QUAR", "quarantined", 7),
     ("WIRE", "wire", 16),
+    ("BUSY", "busy", 14),
     ("AGE", "age_s", 7),
     ("ALERTS", "alerts", 0),
 )
@@ -291,7 +363,10 @@ def top(
     n = 0
     while True:
         now_mono = time.monotonic()
-        rows = [s.poll(now_mono) for s in sources]
+        rows = []
+        for src in sources:
+            polled = src.poll(now_mono)
+            rows.extend(polled if isinstance(polled, list) else [polled])
         frame = render(rows, time.time())
         out(frame if once else _CLEAR + frame)
         n += 1

@@ -213,9 +213,11 @@ def test_alert_only_matches_policy_free_daemon(tmp_path):
             np.asarray(getattr(flags_alert, name)),
             err_msg=name,
         )
-    # verdict sidecars byte-identical modulo the wall-clock ts field
+    # verdict sidecars byte-identical modulo the wall-clock fields
+    # (ts, and the observatory's per-chunk lat_ms stage stamps)
     strip = lambda recs: [
-        {k: v for k, v in r.items() if k != "ts"} for r in recs
+        {k: v for k, v in r.items() if k not in ("ts", "lat_ms")}
+        for r in recs
     ]
     assert strip(v_free) == strip(v_alert)
     # and the adapting run's flags genuinely differ (the reaction is real)

@@ -169,7 +169,8 @@ def test_v2_dirty_quarantine_sidecars_identical(tmp_path, monkeypatch):
 def test_v2_tenant_frames_match_v1_tenant_lines(tmp_path, monkeypatch):
     """The dealt multi-tenant replay over the real socket: v2 frames
     carrying tenant ids produce a verdict sidecar identical (modulo the
-    wall-clock ts) to the v1 TENANT-line replay of the same rows."""
+    wall-clock ts and lat_ms stage stamps) to the v1 TENANT-line replay
+    of the same rows."""
     monkeypatch.chdir(tmp_path)
     stream = planted_prototypes(3, concepts=2, rows_per_concept=320,
                                 features=5)
@@ -200,6 +201,7 @@ def test_v2_tenant_frames_match_v1_tenant_lines(tmp_path, monkeypatch):
         for line in open(banner["verdicts"]):
             rec = json.loads(line)
             rec.pop("ts", None)
+            rec.pop("lat_ms", None)  # wall-clock stage stamps, like ts
             recs.append(json.dumps(rec, sort_keys=True))
         return rep, recs
 

@@ -1,0 +1,398 @@
+"""Serve-pipeline observatory (telemetry.pipeline + the serve runner's
+stage clock): conservation, attribution, sidecar bit-parity with the
+instrumentation off, the jax-free ``pipeline`` CLI, and the fleet
+aggregation plane (``/fleetz`` + ``fleet_*`` series).
+
+The two properties ISSUE 16's acceptance pins:
+
+* **Conservation** — the serve loop is single-threaded, so the sum of
+  per-stage busy seconds can never exceed serve-loop wall-clock, and the
+  row ledger balances: rows admitted == rows sealed == rows published.
+* **Bit-parity** — the stage clocks live outside the dispatch path:
+  verdict sidecars with instrumentation on vs ``--no-pipeline-metrics``
+  are identical modulo wall-clock fields (``ts``, ``lat_ms``).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_drift_detection_tpu import RunConfig
+from distributed_drift_detection_tpu.config import ServeParams
+from distributed_drift_detection_tpu.io import planted_prototypes
+from distributed_drift_detection_tpu.serve import ServeRunner
+from distributed_drift_detection_tpu.serve.loadgen import (
+    _stage_split,
+    format_lines,
+)
+from distributed_drift_detection_tpu.telemetry import pipeline as pl
+from distributed_drift_detection_tpu.telemetry.metrics import MetricsRegistry
+from distributed_drift_detection_tpu.telemetry.ops import OpsServer
+
+
+# -- attribution units (jax-free) --------------------------------------------
+
+
+def test_dominant_stage_excludes_seal_wait():
+    busy = {"seal_wait": 10.0, "device": 2.0, "publish": 1.0}
+    assert pl.dominant_stage(busy) == "device"
+
+
+def test_dominant_stage_idle_loop_names_seal_wait():
+    assert pl.dominant_stage({"seal_wait": 3.0}) == "seal_wait"
+    assert pl.dominant_stage({}) is None
+    assert pl.dominant_stage({"device": 0.0}) is None
+
+
+def test_attribute_shares_utilization_ceiling():
+    busy = {"device": 3.0, "collect": 1.0}
+    rep = pl.attribute(busy, wall_s=8.0, rows=4000)
+    assert rep["dominant_stage"] == "device"
+    assert rep["busy_total_s"] == 4.0
+    assert rep["coverage"] == 0.5
+    # stages come busy-ordered, dominant first
+    assert list(rep["stages"]) == ["device", "collect"]
+    dev = rep["stages"]["device"]
+    assert dev["share"] == 0.75
+    assert dev["utilization"] == 0.375
+    assert dev["ceiling_rows_per_sec"] == pytest.approx(4000 / 3.0, rel=1e-3)
+    assert sum(c["share"] for c in rep["stages"].values()) == pytest.approx(1.0)
+
+
+def test_stage_clock_mirrors_registry_and_guards_negatives():
+    reg = MetricsRegistry()
+    clock = pl.ServeStageClock(reg)
+    clock.add("device", 1.5)
+    clock.add("device", 0.5)
+    clock.add("publish", -3.0)  # clock skew: dropped, not crashed
+    clock.add("publish", 0.25)
+    assert clock.busy == {"device": 2.0, "publish": 0.25}
+    assert pl.serve_stage_breakdown(reg) == {"device": 2.0, "publish": 0.25}
+
+
+def test_render_report_names_dominant_stage():
+    rep = pl.attribute({"device": 3.0, "feed": 1.0}, wall_s=5.0, rows=100)
+    rep["source"] = "unit.prom"
+    text = pl.render_report(rep)
+    assert "dominant stage: device" in text
+    assert "unit.prom" in text
+    assert "coverage 80.0%" in text
+
+
+# -- CLI (jax-free) ----------------------------------------------------------
+
+
+_PROM = """\
+# HELP serve_stage_busy_seconds_total busy
+# TYPE serve_stage_busy_seconds_total counter
+serve_stage_busy_seconds_total{stage="device"} 6.0
+serve_stage_busy_seconds_total{stage="collect"} 1.0
+serve_stage_busy_seconds_total{stage="seal_wait"} 2.0
+# HELP serve_loop_wall_seconds wall
+# TYPE serve_loop_wall_seconds gauge
+serve_loop_wall_seconds 10.0
+# HELP serve_rows_published rows
+# TYPE serve_rows_published gauge
+serve_rows_published 1200
+"""
+
+
+def test_pipeline_cli_prom_golden(tmp_path, capsys):
+    prom = tmp_path / "run.prom"
+    prom.write_text(_PROM)
+    assert pl.main([str(prom)]) == 0
+    out = capsys.readouterr().out
+    assert "dominant stage: device" in out
+    assert "rows published 1200" in out
+
+    assert pl.main([str(prom), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["dominant_stage"] == "device"
+    assert rep["wall_s"] == 10.0
+    assert rep["rows"] == 1200
+    assert rep["stages"]["device"]["ceiling_rows_per_sec"] == 200.0
+    assert rep["coverage"] == pytest.approx(0.9)
+
+
+def test_pipeline_cli_run_log_sibling(tmp_path, capsys):
+    (tmp_path / "run.prom").write_text(_PROM)
+    (tmp_path / "run.jsonl").write_text("")
+    assert pl.main([str(tmp_path / "run.jsonl")]) == 0
+    assert "dominant stage: device" in capsys.readouterr().out
+
+
+def test_pipeline_cli_errors_exit_2(tmp_path, capsys):
+    assert pl.main([str(tmp_path / "missing.prom")]) == 2
+    empty = tmp_path / "empty.prom"
+    empty.write_text("# nothing here\n")
+    assert pl.main([str(empty)]) == 2
+    err = capsys.readouterr().err
+    assert "no serve" in err or "no-pipeline-metrics" in err
+
+
+# -- fleet aggregation (jax-free) --------------------------------------------
+
+
+def _statusz(rows, rps, busy, wall):
+    return {
+        "rows": {"published": rows},
+        "rows_per_sec": rps,
+        "pipeline": {"busy_s": busy, "wall_s": wall},
+    }
+
+
+def test_aggregate_fleet_sums_and_maxes():
+    b0 = pl.backend_snapshot(
+        "b0", _statusz(100, 50.0, {"device": 3.0, "collect": 1.0}, 5.0)
+    )
+    b1 = pl.backend_snapshot(
+        "b1", _statusz(300, 150.0, {"publish": 2.0, "device": 0.5}, 5.0)
+    )
+    dead = pl.backend_snapshot("b2", None)
+    fz = pl.aggregate_fleet([b0, b1, dead])
+    fleet = fz["fleet"]
+    assert fleet["backends"] == 3 and fleet["alive"] == 2
+    assert fleet["rows"] == 400
+    assert fleet["rows_per_sec"] == pytest.approx(200.0)
+    assert fleet["bottlenecks"] == {"b0": "device", "b1": "publish"}
+    assert fleet["stage_busy_share_max"]["device"] == {
+        "share": 0.75,
+        "backend": "b0",
+    }
+    assert fleet["stage_busy_share_max"]["publish"]["backend"] == "b1"
+    assert fz["backends"][2] == {"name": "b2", "alive": False}
+
+
+def test_backend_snapshot_metrics_text_fallback():
+    # a backend whose /statusz predates the pipeline section still gets
+    # attributed from its /metrics exposition scrape
+    snap = pl.backend_snapshot(
+        "old", {"rows": {"published": 7}, "rows_per_sec": 3.5}, _PROM
+    )
+    assert snap["alive"] and snap["bottleneck"] == "device"
+    assert snap["busy_share"]["device"] == pytest.approx(6.0 / 9.0, rel=1e-3)
+
+
+def test_fleet_metrics_lines_prometheus_shape():
+    fz = pl.aggregate_fleet(
+        [pl.backend_snapshot("b0", _statusz(10, 5.0, {"device": 1.0}, 2.0))]
+    )
+    text = "\n".join(pl.fleet_metrics_lines(fz))
+    assert "fleet_rows_per_sec 5.0" in text
+    assert "fleet_backends_alive 1" in text
+    assert 'fleet_stage_busy_share_max{stage="device"} 1.0' in text
+    assert 'fleet_backend_bottleneck{backend="b0",stage="device"} 1' in text
+
+
+def test_fleetz_endpoint_serves_aggregate():
+    import urllib.request
+
+    fz = pl.aggregate_fleet(
+        [pl.backend_snapshot("b0", _statusz(10, 5.0, {"device": 1.0}, 2.0))]
+    )
+    srv = OpsServer(
+        "127.0.0.1",
+        0,
+        metrics_fn=lambda: "",
+        health_fn=lambda: (200, {}),
+        status_fn=dict,
+        fleetz_fn=lambda: fz,
+    )
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/fleetz", timeout=5
+        ) as resp:
+            got = json.loads(resp.read().decode())
+        assert got["fleet"]["rows_per_sec"] == 5.0
+    finally:
+        srv.stop()
+
+
+def test_fleetz_404_without_aggregator():
+    import urllib.error
+    import urllib.request
+
+    srv = OpsServer(
+        "127.0.0.1",
+        0,
+        metrics_fn=lambda: "",
+        health_fn=lambda: (200, {}),
+        status_fn=dict,
+    )
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/fleetz", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# -- loadgen stage split (jax-free) ------------------------------------------
+
+
+def test_stage_split_percentiles_and_absence():
+    recs = [
+        {"lat_ms": {"queue": 1.0, "device": 10.0}},
+        {"lat_ms": {"queue": 3.0, "device": 30.0, "collect": 0.5}},
+    ]
+    split = _stage_split(recs)
+    assert set(split) == {"queue", "device", "collect"}
+    assert split["queue"]["p50"] == pytest.approx(2.0)
+    assert split["device"]["p99"] == pytest.approx(29.8, rel=1e-3)
+    # pre-observatory daemons: no stamps anywhere → None, not {}
+    assert _stage_split([{"rows_through": 5}]) is None
+    assert _stage_split([]) is None
+
+
+# -- top BUSY cell (jax-free) ------------------------------------------------
+
+
+def test_top_busy_cell_and_column():
+    from distributed_drift_detection_tpu.telemetry import top
+
+    assert ("BUSY", "busy", 14) in top._COLUMNS
+    cell = top._busy_cell(
+        {"dominant_stage": "device", "shares": {"device": 0.62, "feed": 0.1}}
+    )
+    assert cell == "device:62%"
+    assert top._busy_cell({}) is None
+
+
+# -- serve-loop conservation + parity (jax) ----------------------------------
+
+
+def _cfg(seed, telemetry_dir=None):
+    return RunConfig(
+        partitions=4,
+        per_batch=50,
+        model="centroid",
+        shuffle_batches=True,
+        results_csv="",
+        seed=seed,
+        window=1,
+        data_policy="quarantine",
+        telemetry_dir=telemetry_dir,
+    )
+
+
+def _params(stream, **kw):
+    kw.setdefault("port", None)
+    kw.setdefault("chunk_batches", 2)
+    kw.setdefault("linger_s", 0.05)
+    return ServeParams(
+        num_features=stream.num_features,
+        num_classes=stream.num_classes,
+        **kw,
+    )
+
+
+def _drive(runner, lines, block=150):
+    for i in range(0, len(lines), block):
+        runner.admission.admit_lines(lines[i : i + block])
+    runner.batcher.flush()
+    runner.request_stop()
+    assert runner.serve_forever() == 0
+    return runner
+
+
+def _serve(tmp_path, name, **params_kw):
+    stream = planted_prototypes(3, concepts=2, rows_per_concept=400,
+                                features=5)
+    cfg = _cfg(3, telemetry_dir=str(tmp_path / name))
+    runner = ServeRunner(cfg, _params(stream, **params_kw))
+    banner = runner.start()
+    _drive(runner, format_lines(stream.X, stream.y))
+    return runner, banner, stream
+
+
+def test_serve_conservation_and_statusz(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runner, banner, stream = _serve(tmp_path, "on")
+
+    snap = runner.pipeline_snapshot()
+    assert snap is not None
+    busy = snap["busy_s"]
+    # every publish-path stage measured something on a drained run
+    # (seal_wait is accounted but ~0 here: rows are pre-admitted, the
+    # loop never blocks for input)
+    for stage in ("feed", "device", "collect", "publish"):
+        assert busy.get(stage, 0.0) > 0.0, stage
+    assert "seal_wait" in busy
+    # conservation: single-threaded loop → busy sum <= wall
+    assert sum(busy.values()) <= snap["wall_s"] + 1e-6
+    assert 0.0 < snap["coverage"] <= 1.0 + 1e-9
+    assert snap["dominant_stage"] in pl.SERVE_STAGES
+
+    # the row ledger balances end to end
+    admitted = runner.batcher.rows_admitted
+    sealed = runner.batcher.depth()["rows_sealed"]
+    assert admitted == sealed == runner._rows_published == stream.num_rows
+
+    # /statusz carries the pipeline section + rows_per_sec
+    st = runner._statusz()
+    assert st["pipeline"]["dominant_stage"] == snap["dominant_stage"]
+    assert st["rows_per_sec"] > 0
+
+    # the registry exposition is self-sufficient for the CLI
+    text = runner.metrics.to_prometheus_text()
+    p_busy, p_wall, p_rows = pl._samples_from_prom(text)
+    assert p_rows == stream.num_rows
+    assert sum(p_busy.values()) <= p_wall + 1e-6
+    prom = tmp_path / "live.prom"
+    prom.write_text(text)
+    assert pl.main([str(prom)]) == 0
+
+
+def test_health_names_bottleneck_on_stall_alert(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runner, _, _ = _serve(tmp_path, "hb")
+
+    class _SLO:
+        def active(self):
+            return [{"rule": "stall_s", "value": 99.0}]
+
+    runner._slo = _SLO()
+    code, payload = runner._health()
+    assert code == 503
+    assert payload["bottleneck_stage"] == runner.pipeline_snapshot()[
+        "dominant_stage"
+    ]
+
+
+def _canon(path):
+    """Verdict records modulo wall-clock: ts and the per-chunk latency
+    stamps (lat_ms) are timing, everything else must be bit-identical."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            rec.pop("ts", None)
+            rec.pop("lat_ms", None)
+            out.append(rec)
+    return out
+
+
+def test_sidecar_bit_parity_instrumentation_on_off(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    r_on, b_on, _ = _serve(tmp_path, "on", pipeline_metrics=True)
+    r_off, b_off, _ = _serve(tmp_path, "off", pipeline_metrics=False)
+
+    assert r_off.pipeline_snapshot() is None
+    on, off = _canon(b_on["verdicts"]), _canon(b_off["verdicts"])
+    assert on == off and on
+
+    # lat_ms itself is schema-stable: present in BOTH modes with the
+    # same component keys (the loadgen split never depends on the flag)
+    with open(b_off["verdicts"]) as fh:
+        rec = json.loads(fh.readline())
+    assert rec["lat_ms"] and set(rec["lat_ms"]) <= {
+        "admission", "queue", "device", "collect",
+    }
+    split = _stage_split([rec])
+    assert split and "device" in split
