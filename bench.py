@@ -1576,6 +1576,79 @@ def sched_bench(workers: int, trials: int) -> None:
     )
 
 
+def _history_stats(batches: int = 2000, series: int = 32) -> dict:
+    """``--history``: micro-bench of the telemetry history store (ISSUE
+    17) — append throughput (``batches`` scrape-shaped batches of
+    ``series`` labeled samples each, flushed + segment-rotated like the
+    collector's writes) and the median latency of a ``rate()`` query over
+    the resulting store. Both cells print informationally in the perf CLI
+    (filesystem-bound); the history-smoke CI job and tests/test_history.py
+    own correctness. jax-free."""
+    import shutil
+    import statistics
+    import tempfile
+    import time as _time
+
+    from distributed_drift_detection_tpu.telemetry import history
+
+    root = tempfile.mkdtemp(prefix="history_bench_")
+    try:
+        t0 = _time.monotonic()
+        with history.HistoryStore(root) as store:
+            for b in range(batches):
+                ts = 1_000_000.0 + b
+                store.append_samples(
+                    [
+                        (
+                            "bench_counter_total",
+                            {"instance": f"i{s}"},
+                            float(b * series + s),
+                        )
+                        for s in range(series)
+                    ],
+                    ts=ts,
+                    mono=float(b),
+                )
+        append_span = _time.monotonic() - t0
+        q_times = []
+        for _ in range(20):
+            q0 = _time.monotonic()
+            history.rate(
+                root,
+                "bench_counter_total",
+                labels={"instance": "i0"},
+                window_s=float(batches),
+                at=1_000_000.0 + batches,
+            )
+            q_times.append(_time.monotonic() - q0)
+        segs = len(history.list_segments(root))
+        return {
+            "history_batches": batches,
+            "history_series": series,
+            "history_segments": segs,
+            "history_append_samples_per_sec": round(
+                batches * series / append_span, 1
+            ),
+            "history_rate_query_ms": round(
+                statistics.median(q_times) * 1000.0, 3
+            ),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def history_bench(batches: int, series: int) -> None:
+    """--history mode: print the history-store micro-bench as the one
+    JSON line (jax-free)."""
+    _emit(
+        {
+            "metric": "history_append_samples_per_sec",
+            "unit": "samples/s",
+            **_history_stats(batches, series),
+        }
+    )
+
+
 def smoke() -> None:
     """--smoke mode: the CI-scale artifact-contract check — the headline
     measurement pipeline on the self-contained synthetic rialto stand-in
@@ -1773,6 +1846,7 @@ if __name__ == "__main__":
     is_tenants = len(sys.argv) > 1 and sys.argv[1] == "--tenants"
     is_fleet = len(sys.argv) > 1 and sys.argv[1] == "--fleet"
     is_sched = len(sys.argv) > 1 and sys.argv[1] == "--sched"
+    is_history = len(sys.argv) > 1 and sys.argv[1] == "--history"
     try:
         if is_soak:
             soak(int(float(sys.argv[2])) if len(sys.argv) > 2 else 1_000_000_000)
@@ -1815,6 +1889,13 @@ if __name__ == "__main__":
                 int(sys.argv[2]) if len(sys.argv) > 2 else 3,
                 int(sys.argv[3]) if len(sys.argv) > 3 else 2,
             )
+        elif is_history:
+            # --history [BATCHES [SERIES]] — history-store append
+            # throughput + rate()-query latency (jax-free).
+            history_bench(
+                int(sys.argv[2]) if len(sys.argv) > 2 else 2000,
+                int(sys.argv[3]) if len(sys.argv) > 3 else 32,
+            )
         else:
             main()
     except Exception as e:  # still emit ONE parseable JSON line on failure
@@ -1834,6 +1915,8 @@ if __name__ == "__main__":
             metric = "fleet_agg_rows_per_sec"
         elif is_sched:
             metric = "sched_cells_per_sec"
+        elif is_history:
+            metric = "history_append_samples_per_sec"
         _emit(
             {
                 "metric": metric,

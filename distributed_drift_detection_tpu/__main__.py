@@ -65,6 +65,8 @@ runs experiments):
     python -m distributed_drift_detection_tpu watch <run.jsonl | DIR> [...]
     python -m distributed_drift_detection_tpu top <run.jsonl | DIR>... [--statusz URL]
     python -m distributed_drift_detection_tpu pipeline <.prom | run.jsonl | URL>
+    python -m distributed_drift_detection_tpu history <range|rate|quantile|top-tenants> STORE [...]
+    python -m distributed_drift_detection_tpu collector --store DIR [--statusz URL | --fleetz URL | --registry DIR]
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
     python -m distributed_drift_detection_tpu timeline <DIR | logs...> [-o OUT]
     python -m distributed_drift_detection_tpu explain <DIR | run.jsonl | bundle>
@@ -86,7 +88,12 @@ rates, active alerts — from tailed logs and/or serving daemons'
 renders the serve-pipeline observatory — per-stage busy share,
 utilization, implied rows/s ceiling and the dominant (bottleneck)
 stage — from a metrics export or a live daemon
-(telemetry.pipeline); ``correlate`` merges a multi-host run's
+(telemetry.pipeline); ``history`` queries a durable time-series store
+— range/rate/quantile over any stored series, per-tenant hotness
+ranking, sparkline or JSON output (telemetry.history); ``collector``
+is the scraper daemon that builds such a store from a fleet's ops
+endpoints and can judge burn-rate SLO rules against it
+(telemetry.collector); ``correlate`` merges a multi-host run's
 per-process logs into one timeline with straggler diagnostics
 (telemetry.correlate); ``heal`` diffs a sweep spec against the
 registry's completed runs and emits — or ``--execute``s under the
@@ -126,6 +133,8 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu watch RUN_JSONL_OR_DIR\n"
     "       python -m distributed_drift_detection_tpu top DIR_OR_LOGS [--statusz URL]\n"
     "       python -m distributed_drift_detection_tpu pipeline PROM_OR_LOG_OR_URL [--json]\n"
+    "       python -m distributed_drift_detection_tpu history QUERY STORE [SERIES] [...]\n"
+    "       python -m distributed_drift_detection_tpu collector --store DIR [--statusz URL ...]\n"
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
     "       python -m distributed_drift_detection_tpu timeline DIR_OR_LOGS [-o OUT]\n"
     "       python -m distributed_drift_detection_tpu explain DIR_OR_LOG_OR_BUNDLE\n"
@@ -185,6 +194,18 @@ def main(argv: list[str]) -> None:
         from .telemetry.pipeline import main as pipeline_main
 
         raise SystemExit(pipeline_main(argv[1:]))
+    if argv and argv[0] == "history":
+        # jax-free: the time-series store is queried wherever it lands
+        # (telemetry.history — the fleet's durable metrics memory).
+        from .telemetry.history import main as history_main
+
+        raise SystemExit(history_main(argv[1:]))
+    if argv and argv[0] == "collector":
+        # jax-free: the fleet scraper daemon only GETs ops endpoints and
+        # appends to a history store (telemetry.collector).
+        from .telemetry.collector import main as collector_main
+
+        raise SystemExit(collector_main(argv[1:]))
     if argv and argv[0] == "correlate":
         # jax-free: multi-host logs are merged wherever they are mirrored.
         from .telemetry.correlate import main as correlate_main

@@ -340,10 +340,22 @@ class ServeParams(NamedTuple):
     # (JSON snapshot). Binds to `host`, like the ingress.
     ops_port: "int | None" = None
     # Declarative SLO rules, `kind=threshold` each (telemetry.slo
-    # RULE_KINDS: p99_ms, verdict_age_s, quarantine_pct, stall_s);
-    # ("none",) disables alerting. The default ships a stall alarm so an
-    # out-of-the-box daemon can tell "wedged" from "idle".
+    # RULE_KINDS: p99_ms, verdict_age_s, quarantine_pct, stall_s) or a
+    # multi-window `burn_rate=SERIES:OBJECTIVE:FAST/SLOW:FACTOR` pair
+    # over any snapshot series; ("none",) disables alerting. The default
+    # ships a stall alarm so an out-of-the-box daemon can tell "wedged"
+    # from "idle".
     slo: tuple = ("stall_s=60",)
+    # Per-tenant hotness series (serve --tenant-series): export
+    # serve_tenant_rows_total{tenant=<global id>} on /metrics so the
+    # history plane can rank tenant activity (`history top-tenants`).
+    # Off by default — per-tenant label values are a cardinality cost
+    # every scrape pays forever, so hotness is an opt-in fleet posture.
+    tenant_series: bool = False
+    # Cardinality guard for the above: a daemon with more tenant slots
+    # than this refuses --tenant-series at startup instead of silently
+    # flooding every scrape (raise it explicitly if you mean it).
+    tenant_series_max: int = 512
     # Evaluator cadence (its own daemon thread — the serve loop being
     # wedged is exactly what stall_s must catch).
     slo_interval_s: float = 1.0

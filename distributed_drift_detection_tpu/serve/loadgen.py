@@ -445,6 +445,7 @@ def _run_loadgen_tenants(
     wire_version: str = "v1",
     arrays=None,
     fleet_dirs=None,
+    weights=None,
 ) -> dict:
     """Multi-tenant replay: the stream is dealt round-robin (blocks of
     ``interleave`` rows) across T tenant slots over ONE connection, with
@@ -465,8 +466,21 @@ def _run_loadgen_tenants(
     joins on each record entry's global ``id`` — a migrated tenant's
     verdicts continue its ``rows_through`` sequence from the landing
     daemon's sidecar, so one summary covers the whole fleet with the
-    per-tenant latency math unchanged."""
+    per-tenant latency math unchanged.
+
+    ``weights`` (len T, positive) skews the dealing: blocks go to
+    tenants by smooth weighted round-robin — fully deterministic (same
+    weights → same dealing, so parity runs stay reproducible), with
+    tenant t receiving a ``weights[t]/sum(weights)`` share of blocks.
+    The Zipf-ish traffic split the history plane's hotness ranking is
+    validated against. ``None`` = the uniform round-robin of old."""
     global_ids = fleet_dirs is not None
+    if weights is not None:
+        if len(weights) != tenants or any(w <= 0 for w in weights):
+            raise ValueError(
+                f"tenant weights must be {tenants} positive numbers, "
+                f"got {weights!r}"
+            )
 
     def _key(ent) -> int:
         # fleet join key: the record entry's GLOBAL tenant id (== the
@@ -479,8 +493,19 @@ def _run_loadgen_tenants(
     # wire segments: (tenant, [row indices]) in send order.
     streams: list[list[int]] = [[] for _ in range(tenants)]
     segments: list[tuple[int, list[int]]] = []
+    wrr = [0.0] * tenants  # smooth-WRR credit (weights mode only)
+    w_total = float(sum(weights)) if weights is not None else 0.0
     for base in range(0, n_rows, interleave):
-        t = (base // interleave) % tenants
+        if weights is None:
+            t = (base // interleave) % tenants
+        else:
+            # smooth weighted round-robin (nginx's): every tenant gains
+            # its weight in credit, the richest takes the block and pays
+            # the total back — deterministic, maximally interleaved
+            for i in range(tenants):
+                wrr[i] += float(weights[i])
+            t = max(range(tenants), key=lambda i: (wrr[i], -i))
+            wrr[t] -= w_total
         idx = list(range(base, min(base + interleave, n_rows)))
         streams[t].extend(idx)
         segments.append((t, idx))
@@ -650,6 +675,7 @@ def run_loadgen(
     arrays=None,
     frame_rows: int = 1024,
     fleet_dirs=None,
+    tenant_weights=None,
 ) -> dict:
     """Drive one replay and measure the SLO (see module docstring).
     ``expect_rows`` overrides how many admitted rows the verdict stream
@@ -685,6 +711,8 @@ def run_loadgen(
     trace_ctx = sample_traces(
         n_rows if wire_version == "v1" else 0, trace_sample, trace_seed
     )
+    if tenant_weights is not None and tenants <= 1:
+        raise ValueError("tenant_weights needs tenants > 1")
     if tenants > 1:
         return _run_loadgen_tenants(
             host, port, lines, tenants,
@@ -693,7 +721,7 @@ def run_loadgen(
             expect_rows=expect_rows, trace_ctx=trace_ctx,
             trace_log=trace_log, label_lag=label_lag,
             wire_version=wire_version, arrays=arrays,
-            fleet_dirs=fleet_dirs,
+            fleet_dirs=fleet_dirs, weights=tenant_weights,
         )
     tail = (
         _FleetVerdictTail(fleet_dirs)
@@ -808,6 +836,12 @@ def main(argv=None) -> None:
                     help="deal the replay round-robin across N tenant "
                     "slots of a multi-tenant daemon (TENANT wire lines, "
                     "per-tenant latency attribution)")
+    ap.add_argument("--tenant-weights", default=None, metavar="W0,W1,...",
+                    help="skew the multi-tenant dealing: one positive "
+                    "weight per tenant (len == --tenants), blocks dealt "
+                    "by deterministic smooth weighted round-robin — e.g. "
+                    "a Zipf-ish 8,4,2,1 hotness split for exercising "
+                    "`history top-tenants` (default: uniform)")
     ap.add_argument("--wire", choices=("v1", "v2"), default="v1",
                     help="wire protocol: v1 = text lines (default), "
                     "v2 = binary columnar frames (serve.wire) — "
@@ -906,6 +940,22 @@ def main(argv=None) -> None:
         )
     if args.delayed_labels and args.rate <= 0:
         ap.error("--delayed-labels is a pacing mode and needs --rate > 0")
+    tenant_weights = None
+    if args.tenant_weights:
+        if args.tenants <= 1:
+            ap.error("--tenant-weights needs --tenants > 1")
+        try:
+            tenant_weights = [
+                float(w) for w in args.tenant_weights.split(",")
+            ]
+        except ValueError:
+            ap.error(f"--tenant-weights must be comma-separated numbers, "
+                     f"got {args.tenant_weights!r}")
+        if len(tenant_weights) != args.tenants or any(
+            w <= 0 for w in tenant_weights
+        ):
+            ap.error(f"--tenant-weights needs {args.tenants} positive "
+                     f"weights, got {args.tenant_weights!r}")
     t0 = time.monotonic()
     report = run_loadgen(
         args.host,
@@ -924,6 +974,7 @@ def main(argv=None) -> None:
         arrays=(X, y) if args.wire == "v2" else None,
         frame_rows=args.frame_rows,
         fleet_dirs=dirs if args.router else None,
+        tenant_weights=tenant_weights,
     )
     report.update(
         source=args.source,

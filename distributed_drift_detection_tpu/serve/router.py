@@ -1331,7 +1331,12 @@ class TenantRouter:
                     status = metrics = None
             snaps.append(
                 backend_snapshot(
-                    b.name or repr(b.spec), status, metrics
+                    b.name or repr(b.spec),
+                    status,
+                    metrics,
+                    # ops address rides into the fleetz row: the history
+                    # collector's --fleetz discovery scrapes it
+                    ops=f"{b.spec.host}:{b.spec.ops_port}",
                 )
             )
         return aggregate_fleet(snaps)

@@ -216,6 +216,9 @@ class ServeRunner:
         self._stage_clock = None
         self._wall_gauge = None
         self._rows_gauge = None
+        # Per-tenant hotness series (params.tenant_series): rows counter
+        # labeled by GLOBAL tenant id — the history plane's ranking food.
+        self._tenant_rows = None
         self._chunk_tracer = None
         self._loop_start_mono: "float | None" = None
         self._inflight_n = 0
@@ -295,6 +298,27 @@ class ServeRunner:
             )
         if params.flightrec_events > 0:
             self._recorder = FlightRecorder(params.flightrec_events)
+        if params.tenant_series:
+            # Cardinality guard: per-tenant label values multiply every
+            # scrape forever — refuse loudly rather than melt the store.
+            from ..telemetry.history import (
+                TENANT_ROWS_HELP,
+                TENANT_ROWS_METRIC,
+            )
+
+            if self.tenants > params.tenant_series_max:
+                raise ValueError(
+                    f"--tenant-series refused: {self.tenants} tenants "
+                    f"exceed tenant_series_max={params.tenant_series_max} "
+                    "(raise the cap explicitly if you mean it)"
+                )
+            self._tenant_rows = self._metrics.counter(
+                TENANT_ROWS_METRIC, help=TENANT_ROWS_HELP
+            )
+            for gid in self.tenant_ids:
+                # pre-register every tenant at 0 so the series (and its
+                # HELP line) is scrapeable before the first publish
+                self._tenant_rows.inc(0.0, tenant=str(int(gid)))
         ident = None
         if cfg.telemetry_dir:
             from ..parallel.multihost import host_identity
@@ -573,7 +597,9 @@ class ServeRunner:
         # SLO engine + evaluator thread: the judge must not live on the
         # serve loop — the loop being wedged is what stall_s detects.
         rules = parse_rules(params.slo)
-        self._slo = SloEngine(rules)
+        # metrics= exports slo_alert_active{rule} gauges: a scraper (the
+        # collector, top) sees live alert state, not just the log tail.
+        self._slo = SloEngine(rules, metrics=self._metrics)
         if rules:
             self._slo_thread, self._slo_stop = start_evaluator(
                 self._slo,
@@ -592,6 +618,21 @@ class ServeRunner:
                 status_fn=self._statusz,
             )
             self._ops.start()
+            if self._log is not None and cfg.telemetry_dir:
+                # Second "running" record carrying the now-bound ops
+                # address: registry.runs() MERGES extras per run_id, so
+                # this augments (not replaces) the first record — the
+                # collector's --registry discovery scrapes this field.
+                from ..telemetry import registry as run_registry
+
+                run_registry.record(
+                    cfg.telemetry_dir,
+                    self._log.run_id,
+                    "running",
+                    kind="serve",
+                    ops=f"{params.host}:{self._ops.port}",
+                    **({"name": params.name} if params.name else {}),
+                )
         return {
             "serving": True,
             "tenants": self.tenants,
@@ -1243,6 +1284,17 @@ class ServeRunner:
         self._flag_base += int(cg.shape[1])
         self._published += 1
         self._rows_published = int(meta["rows_through"])
+        if self._tenant_rows is not None:
+            # labeled by GLOBAL id (tenant_ids), same join key as the
+            # verdict sidecar entries — a migrated tenant's rate follows
+            # it across backends under one label value
+            t_rows = meta.get("t_rows") or [meta["rows"]]
+            for t in range(min(self.tenants, len(t_rows))):
+                if int(t_rows[t]):
+                    self._tenant_rows.inc(
+                        float(int(t_rows[t])),
+                        tenant=str(int(self.tenant_ids[t])),
+                    )
         self._detections += int(changed.sum())
         self._last_meta = meta
         # any publish postdates every applied LOADTENANT (controls run
@@ -1563,8 +1615,17 @@ def main(argv=None) -> None:
     ap.add_argument("--slo", action="append", default=None,
                     metavar="KIND=THRESHOLD",
                     help="SLO alert rule (p99_ms|verdict_age_s|"
-                    "quarantine_pct|stall_s), repeatable; 'none' disables. "
-                    "Default: stall_s=60")
+                    "quarantine_pct|stall_s) or a multi-window "
+                    "burn_rate=SERIES:OBJECTIVE:FAST/SLOW:FACTOR pair, "
+                    "repeatable; 'none' disables. Default: stall_s=60")
+    ap.add_argument("--tenant-series", action="store_true",
+                    help="export serve_tenant_rows_total{tenant=<global "
+                    "id>} per-tenant rows counters on /metrics — the "
+                    "history plane's hotness-ranking input (cardinality-"
+                    "guarded by --tenant-series-max)")
+    ap.add_argument("--tenant-series-max", type=int, default=512,
+                    help="refuse --tenant-series beyond this many tenant "
+                    "slots instead of flooding every scrape (default 512)")
     ap.add_argument("--slo-interval-s", type=float, default=1.0,
                     help="SLO evaluator cadence (its own thread)")
     ap.add_argument("--flightrec-events", type=int, default=256,
@@ -1659,6 +1720,8 @@ def main(argv=None) -> None:
         ops_port=args.ops_port,
         slo=tuple(args.slo) if args.slo else ServeParams._field_defaults["slo"],
         slo_interval_s=args.slo_interval_s,
+        tenant_series=args.tenant_series,
+        tenant_series_max=args.tenant_series_max,
         flightrec_events=args.flightrec_events,
         trace_sample=args.trace_sample,
         pipeline_metrics=not args.no_pipeline_metrics,

@@ -64,7 +64,23 @@ subsystem persists that answer as artifacts instead:
   crossings emit schema-v1 ``alert`` events and drive ``/healthz``.
 * :mod:`.top` — ``python -m distributed_drift_detection_tpu top``: one
   refreshing terminal dashboard over many runs, from tailed logs and/or
-  ``/statusz`` endpoints.
+  ``/statusz`` endpoints; ``--store`` adds per-row TREND sparklines from
+  a history store, ``--record``/``--replay`` persist and play back
+  dashboard frames.
+* :mod:`.history` — the durable time-series plane: an append-only,
+  segment-rotated on-disk store for scraped samples, with retention by
+  age/size, step-aligned downsampling and PromQL-ish query primitives
+  (``range``/``rate``/``quantile_over_time``/``top-tenants``) behind the
+  ``history`` CLI.
+* :mod:`.collector` — the fleet scraper daemon: discovers serve targets
+  from ``--statusz`` URLs, a router's ``/fleetz`` or the telemetry
+  registry, polls ``/metrics`` + ``/statusz`` on an interval into a
+  history store (wall + monotonic stamps, per-target ``up`` marking,
+  self-metering), and can evaluate multi-window burn-rate SLO rules
+  against the store.
+* :mod:`.pipeline` — serve-pipeline bottleneck attribution from stage
+  busy counters; ``--window`` replays the same attribution from a
+  history store over a trailing window.
 
 Telemetry is **off by default** (``RunConfig.telemetry_dir=None``): every
 hook is an ``if log is not None`` guard outside the timed span, so the

@@ -181,6 +181,12 @@ CELLS = (
     # stream geometry and the chunk span; the adapt-smoke CI job and
     # tests/test_adapt.py own correctness.
     ("serve_adapt_recovery_rows", _DOWN, False, "rows"),
+    # History plane micro-bench (bench.py --history, r17+): append and
+    # query throughput of the jax-free on-disk series store. Informational
+    # — both move with the filesystem under the runner; the history-smoke
+    # CI job and tests/test_history.py own correctness.
+    ("history_append_samples_per_sec", _UP, False, "samples/s"),
+    ("history_rate_query_ms", _DOWN, False, "ms"),
     ("xla_flops", _DOWN, False, "flops"),
     ("xla_bytes_accessed", _DOWN, False, "B"),
     ("xla_temp_bytes", _DOWN, False, "B"),
@@ -467,6 +473,8 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "sched_serial_cells_per_sec",
         "sched_speedup",
         "serve_adapt_recovery_rows",
+        "history_append_samples_per_sec",
+        "history_rate_query_ms",
         "mean_delay_batches",
         "detections",
     ):

@@ -19,7 +19,11 @@ the jax-free measurement vocabulary and the report that reads it:
   and the named dominant stage.
 * :func:`main` — the ``pipeline`` CLI: reads a ``.prom`` / run-log
   sibling / live ``/statusz`` URL and renders the bottleneck report
-  ROADMAP item 1's perf work is judged against.
+  ROADMAP item 1's perf work is judged against. ``--window S`` points
+  it at a history store (:mod:`.history`) instead and attributes the
+  busy-counter *deltas* over the last S seconds
+  (:func:`load_window_report`) — where the recent wall went, not
+  cumulative-since-boot.
 * :func:`aggregate_fleet` — folds per-backend snapshots into the
   ``/fleetz`` envelope the router and scheduler publish (summed rows/s,
   max per-stage busy share, per-backend bottleneck).
@@ -249,6 +253,92 @@ def load_report(source: str, timeout: float = 5.0) -> dict:
     return report
 
 
+def load_window_report(
+    store_dir: str,
+    window_s: float,
+    *,
+    instance: "str | None" = None,
+    at: "float | None" = None,
+) -> dict:
+    """Attribution over a TIME RANGE from a history store
+    (``pipeline --window``): per-stage busy deltas between the window's
+    edge samples of ``serve_stage_busy_seconds_total``, wall from the
+    daemon's own ``serve_loop_wall_seconds`` delta (falling back to the
+    scrape timestamps), rows from the ``serve_rows_published`` delta —
+    "where did the last N minutes go", not cumulative-since-boot. The
+    same :func:`attribute` fold as every other renderer, so live and
+    windowed reports are directly comparable."""
+    import time as _time
+
+    from .history import read_samples
+
+    if at is None:
+        at = _time.time()
+    labels = {"instance": instance} if instance else None
+    recs = read_samples(
+        store_dir,
+        name=SERVE_STAGE_BUSY_METRIC,
+        labels=labels,
+        start=at - window_s,
+        end=at,
+    )
+    if not recs:
+        raise ValueError(
+            f"{store_dir}: no {SERVE_STAGE_BUSY_METRIC} samples in the "
+            f"last {window_s:g}s"
+            + (f" for instance {instance!r}" if instance else "")
+            + " (collector not scraping, or daemon ran with "
+            "--no-pipeline-metrics)"
+        )
+    instances = sorted(
+        {(r.get("labels") or {}).get("instance", "") for r in recs}
+    )
+    if instance is None and len(instances) > 1:
+        raise ValueError(
+            f"store holds {len(instances)} instances "
+            f"({', '.join(instances)}); pick one with --instance"
+        )
+    # per-stage counter delta between the window's edge samples
+    by_stage: dict[str, list] = {}
+    for r in recs:
+        stage = (r.get("labels") or {}).get("stage")
+        if stage:
+            by_stage.setdefault(stage, []).append(r)
+    busy: dict[str, float] = {}
+    edges: "tuple | None" = None
+    for stage, srecs in by_stage.items():
+        srecs.sort(key=lambda r: float(r["ts"]))
+        first, last = srecs[0], srecs[-1]
+        # counter semantics: a restarted daemon resets to 0 — a negative
+        # delta means the window spans the restart; count from zero then
+        d = float(last["value"]) - float(first["value"])
+        busy[stage] = d if d >= 0 else float(last["value"])
+        if edges is None or float(last["ts"]) - float(first["ts"]) > (
+            float(edges[1]["ts"]) - float(edges[0]["ts"])
+        ):
+            edges = (first, last)
+
+    def _series_delta(name: str) -> "float | None":
+        srecs = read_samples(
+            store_dir, name=name, labels=labels, start=at - window_s, end=at
+        )
+        if len(srecs) < 2:
+            return None
+        srecs.sort(key=lambda r: float(r["ts"]))
+        d = float(srecs[-1]["value"]) - float(srecs[0]["value"])
+        return d if d >= 0 else float(srecs[-1]["value"])
+
+    wall = _series_delta(SERVE_WALL_METRIC)
+    if wall is None and edges is not None:
+        wall = float(edges[1]["ts"]) - float(edges[0]["ts"])
+    rows = _series_delta(SERVE_ROWS_METRIC)
+    report = attribute(busy, wall, rows)
+    report["window_s"] = float(window_s)
+    if instance:
+        report["instance"] = instance
+    return report
+
+
 def render_report(report: dict) -> str:
     """The human table: one row per stage, busy-ordered, dominant first."""
     lines = []
@@ -291,14 +381,24 @@ def render_report(report: dict) -> str:
 
 
 def backend_snapshot(
-    name: str, statusz: "dict | None", metrics_text: "str | None" = None
+    name: str,
+    statusz: "dict | None",
+    metrics_text: "str | None" = None,
+    ops: "str | None" = None,
 ) -> dict:
     """One backend's row in the ``/fleetz`` envelope, from its scraped
     ``/statusz`` (``None`` statusz = unreachable backend). When the
     statusz carries no ``pipeline`` section but a ``/metrics`` scrape is
-    given, the busy map is recovered from the exposition text instead."""
+    given, the busy map is recovered from the exposition text instead.
+    ``ops`` (the backend's ``host:ops_port``) rides along verbatim — the
+    history collector's ``--fleetz`` discovery resolves scrape targets
+    from it."""
     if not statusz:
-        return {"name": name, "alive": False}
+        return {
+            "name": name,
+            "alive": False,
+            **({"ops": ops} if ops else {}),
+        }
     pipe = statusz.get("pipeline") or {}
     busy = pipe.get("busy_s") or {}
     wall = pipe.get("wall_s")
@@ -311,11 +411,16 @@ def backend_snapshot(
         "alive": True,
         "rows": rows,
         "rows_per_sec": statusz.get("rows_per_sec", 0.0),
+        # live SLO alert count from the backend's own engine — summed
+        # into the fleet row so `top` can show fleet-wide alert state
+        "alerts": len(statusz.get("alerts") or []),
         "bottleneck": attr.get("dominant_stage"),
         "busy_share": {
             s: c["share"] for s, c in attr.get("stages", {}).items()
         },
     }
+    if ops:
+        out["ops"] = ops
     return out
 
 
@@ -338,6 +443,7 @@ def aggregate_fleet(backends: list[dict]) -> dict:
             "rows_per_sec": round(
                 sum(float(b.get("rows_per_sec") or 0.0) for b in alive), 3
             ),
+            "alerts": sum(int(b.get("alerts") or 0) for b in alive),
             "stage_busy_share_max": {
                 s: share_max[s] for s in sorted(share_max)
             },
@@ -408,15 +514,39 @@ def main(argv=None) -> int:
     ap.add_argument(
         "source",
         help="metrics export (.prom/.metrics.json), run log (.jsonl), "
-        "or http://host:ops_port of a live daemon",
+        "http://host:ops_port of a live daemon, or — with --window — a "
+        "history store directory",
     )
     ap.add_argument(
         "--json", action="store_true", help="emit the attribution record as JSON"
     )
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument(
+        "--window", type=float, default=None, metavar="S",
+        help="windowed mode: source is a telemetry.history store; "
+        "attribute stage busy-counter DELTAS over the last S seconds "
+        "(where the recent wall-clock went, not since boot)",
+    )
+    ap.add_argument(
+        "--instance", default=None, metavar="NAME",
+        help="with --window: the store instance label to attribute "
+        "(required when the store holds several)",
+    )
+    ap.add_argument(
+        "--at", type=float, default=None, metavar="TS",
+        help="with --window: window end as unix seconds (default: now)",
+    )
     args = ap.parse_args(argv)
+    if (args.instance or args.at is not None) and args.window is None:
+        ap.error("--instance/--at only apply to --window mode")
     try:
-        report = load_report(args.source, timeout=args.timeout)
+        if args.window is not None:
+            report = load_window_report(
+                args.source, args.window, instance=args.instance, at=args.at
+            )
+            report["source"] = args.source
+        else:
+            report = load_report(args.source, timeout=args.timeout)
     except (OSError, ValueError) as e:
         print(f"pipeline: {e}", file=sys.stderr)
         return 2

@@ -26,6 +26,30 @@ same key, ``None`` = not currently measurable, rule skipped):
                        firing means the loop itself is wedged
 =====================  ====================================================
 
+Beyond single-sample thresholds, ``kind=burn_rate`` rules judge a series
+*over time* — the SRE multi-window error-budget pattern. The grammar is
+``burn_rate=SERIES:OBJECTIVE:FAST/SLOW:FACTOR`` (e.g.
+``burn_rate=p99_ms:250:30/300:1.0``): the rule computes the windowed
+average of SERIES over a FAST and a SLOW window (seconds), divides each
+by OBJECTIVE to get a burn rate, and fires only while **both** exceed
+FACTOR — the fast window gives detection latency, the slow window
+vetoes one-sample blips, so a transient spike never pages but a
+sustained burn does. Series values come either from the snapshot dict
+(in-process serve mode: the engine keeps its own ring of recent samples,
+one per evaluator tick) or from a ``window_avg_fn`` the caller injects
+(collector mode: ``history.avg_over_time`` over the fleet store). Burn
+alerts are named ``burn_rate:SERIES`` and ride the same schema-v1
+``alert`` events, so ``report``/``top``/``/healthz`` need no new
+plumbing; multiple burn rules may coexist as long as their series
+differ.
+
+When constructed with a :class:`~.metrics.MetricsRegistry`, the engine
+also exports live alert state as ``slo_alert_active{rule}`` gauges —
+1 while firing, 0 otherwise, pre-registered at 0 for every rule so the
+series (and its HELP line) exists on ``/metrics`` before anything ever
+fires. Gauges are re-synced from the firing set *after* emit-failure
+rollback, so the scraped state never diverges from the log.
+
 The evaluator runs on its own daemon thread (:func:`start_evaluator`):
 the serve loop's blocking points (device sync, an injected
 ``serve.flush`` stall) are exactly what ``stall_s`` must detect, so the
@@ -41,16 +65,74 @@ No jax, stdlib only — importable by the jax-free CLIs.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, NamedTuple
 
 RULE_KINDS = ("p99_ms", "verdict_age_s", "quarantine_pct", "stall_s")
 
+#: The multi-window burn-rate kind (its value part has its own grammar,
+#: see the module docstring; not a snapshot key itself — ``series`` is).
+BURN_KIND = "burn_rate"
+
+#: Live alert state on /metrics: 1 while the labeled rule fires.
+ALERT_ACTIVE_METRIC = "slo_alert_active"
+ALERT_ACTIVE_HELP = (
+    "1 while the SLO rule named by the 'rule' label is firing, 0 "
+    "otherwise (pre-registered at 0 for every configured rule)"
+)
+
 
 class SloRule(NamedTuple):
-    """One declarative rule: fire while ``value > threshold``."""
+    """One declarative rule: fire while ``value > threshold``. For
+    ``kind=burn_rate`` the threshold is the burn FACTOR and the extra
+    fields describe the series and window pair (zero/empty otherwise)."""
 
     kind: str
     threshold: float
+    series: str = ""
+    objective: float = 0.0
+    fast_s: float = 0.0
+    slow_s: float = 0.0
+
+
+def rule_name(rule: SloRule) -> str:
+    """The alert/gauge identity of a rule: the kind for threshold rules,
+    ``burn_rate:SERIES`` for burn rules (several may coexist)."""
+    return f"{rule.kind}:{rule.series}" if rule.kind == BURN_KIND else rule.kind
+
+
+def _parse_burn(spec: str, value: str) -> SloRule:
+    """``SERIES:OBJECTIVE:FAST/SLOW:FACTOR`` → a burn-rate rule."""
+    parts = value.split(":")
+    bad = ValueError(
+        f"bad burn_rate rule {spec!r}; expected "
+        "burn_rate=SERIES:OBJECTIVE:FAST/SLOW:FACTOR "
+        "(e.g. burn_rate=p99_ms:250:30/300:1.0)"
+    )
+    if len(parts) != 4 or not parts[0].strip():
+        raise bad
+    series = parts[0].strip()
+    fast_str, sep, slow_str = parts[2].partition("/")
+    if not sep:
+        raise bad
+    try:
+        objective = float(parts[1])
+        fast_s = float(fast_str)
+        slow_s = float(slow_str)
+        factor = float(parts[3])
+    except ValueError:
+        raise bad from None
+    if objective <= 0 or factor <= 0:
+        raise ValueError(
+            f"burn_rate rule {spec!r}: objective and factor must be > 0"
+        )
+    if not 0 < fast_s < slow_s:
+        raise ValueError(
+            f"burn_rate rule {spec!r}: need 0 < FAST < SLOW "
+            f"(got {fast_s:g}/{slow_s:g}) — the slow window is the veto"
+        )
+    return SloRule(BURN_KIND, factor, series, objective, fast_s, slow_s)
 
 
 def parse_rules(specs) -> tuple[SloRule, ...]:
@@ -64,10 +146,19 @@ def parse_rules(specs) -> tuple[SloRule, ...]:
     for spec in specs:
         kind, sep, value = spec.partition("=")
         kind = kind.strip()
+        if sep and kind == BURN_KIND:
+            rule = _parse_burn(spec, value)
+            if any(rule_name(r) == rule_name(rule) for r in rules):
+                raise ValueError(
+                    f"duplicate burn_rate rule for series {rule.series!r}"
+                )
+            rules.append(rule)
+            continue
         if not sep or kind not in RULE_KINDS:
             raise ValueError(
                 f"bad SLO rule {spec!r}; expected kind=threshold with kind "
-                f"one of {RULE_KINDS} (or the single spec 'none')"
+                f"one of {RULE_KINDS + (BURN_KIND,)} (or the single spec "
+                "'none')"
             )
         try:
             threshold = float(value)
@@ -94,10 +185,60 @@ class SloEngine:
     firing alerts (the ``/healthz`` and ``/statusz`` surface).
     """
 
-    def __init__(self, rules: "tuple[SloRule, ...]"):
+    def __init__(
+        self,
+        rules: "tuple[SloRule, ...]",
+        *,
+        window_avg_fn=None,
+        metrics=None,
+        now_fn: Callable[[], float] = time.monotonic,
+    ):
+        """``window_avg_fn(series, window_s) -> float | None`` supplies
+        windowed averages for burn rules from an external store (the
+        collector injects ``history.avg_over_time`` over the fleet
+        store); without it, the engine rings up its own samples from the
+        snapshot, one per tick. ``metrics`` (a MetricsRegistry) enables
+        the ``slo_alert_active{rule}`` gauges."""
         self.rules = tuple(rules)
         self._firing: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._window_avg_fn = window_avg_fn
+        self._now_fn = now_fn
+        # rule name -> ring of (mono_ts, value) bounded by the slow window
+        self._history: dict[str, deque] = {}
+        self._gauge = None
+        if metrics is not None:
+            self._gauge = metrics.gauge(ALERT_ACTIVE_METRIC, ALERT_ACTIVE_HELP)
+            for rule in self.rules:
+                self._gauge.set(0.0, rule=rule_name(rule))
+
+    def _burn_value(self, rule: SloRule, snapshot: dict) -> "float | None":
+        """Current burn of a burn-rate rule: the *limiting* (smaller) of
+        the fast/slow window burns — above the factor iff BOTH windows
+        burn, which folds the multi-window AND into one scalar the
+        generic threshold state machine can judge. ``None`` while either
+        window is empty."""
+        if self._window_avg_fn is not None:
+            fast = self._window_avg_fn(rule.series, rule.fast_s)
+            slow = self._window_avg_fn(rule.series, rule.slow_s)
+        else:
+            v = snapshot.get(rule.series)
+            ring = self._history.setdefault(rule_name(rule), deque())
+            now = self._now_fn()
+            if v is not None:
+                ring.append((now, float(v)))
+            while ring and ring[0][0] < now - rule.slow_s:
+                ring.popleft()
+            fast_vals = [x for t, x in ring if t >= now - rule.fast_s]
+            slow_vals = [x for _, x in ring]
+            fast = sum(fast_vals) / len(fast_vals) if fast_vals else None
+            slow = sum(slow_vals) / len(slow_vals) if slow_vals else None
+        if fast is None or slow is None:
+            return None
+        # min(): the rule fires iff BOTH burns exceed the factor, i.e.
+        # iff the smaller one does — so the generic `value > threshold`
+        # state machine below needs no special casing.
+        return min(fast / rule.objective, slow / rule.objective)
 
     def evaluate(self, snapshot: dict, emit=None) -> list[dict]:
         """One cadence tick; returns the transitions (also handed, one by
@@ -106,34 +247,39 @@ class SloEngine:
         transitions: list[dict] = []
         with self._lock:
             for rule in self.rules:
-                value = snapshot.get(rule.kind)
+                if rule.kind == BURN_KIND:
+                    value = self._burn_value(rule, snapshot)
+                else:
+                    value = snapshot.get(rule.kind)
                 if value is None:
                     continue
                 value = float(value)
+                name = rule_name(rule)
                 firing = value > rule.threshold
-                was = rule.kind in self._firing
+                was = name in self._firing
                 if firing and not was:
                     rec = {
-                        "rule": rule.kind,
+                        "rule": name,
                         "state": "firing",
                         "value": value,
                         "threshold": rule.threshold,
                     }
-                    self._firing[rule.kind] = rec
+                    self._firing[name] = rec
                     transitions.append(rec)
                 elif firing and was:
                     # keep the surfaced value current for /statusz
-                    self._firing[rule.kind]["value"] = value
+                    self._firing[name]["value"] = value
                 elif not firing and was:
-                    del self._firing[rule.kind]
+                    del self._firing[name]
                     transitions.append(
                         {
-                            "rule": rule.kind,
+                            "rule": name,
                             "state": "resolved",
                             "value": value,
                             "threshold": rule.threshold,
                         }
                     )
+        emitted = transitions
         if emit is not None:
             for i, t in enumerate(transitions):
                 try:
@@ -152,8 +298,21 @@ class SloEngine:
                                 self._firing[u["rule"]] = {
                                     **u, "state": "firing"
                                 }
-                    return transitions[:i]
-        return transitions
+                    emitted = transitions[:i]
+                    break
+        self._sync_gauges()
+        return emitted
+
+    def _sync_gauges(self) -> None:
+        """Re-derive every ``slo_alert_active`` gauge from the firing set
+        — called after emit handling so rollback is reflected too."""
+        if self._gauge is None:
+            return
+        with self._lock:
+            firing = set(self._firing)
+        for rule in self.rules:
+            name = rule_name(rule)
+            self._gauge.set(1.0 if name in firing else 0.0, rule=name)
 
     def active(self) -> list[dict]:
         """Currently firing alerts (copies, newest values)."""

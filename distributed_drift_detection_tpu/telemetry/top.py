@@ -22,6 +22,18 @@ data sources, freely mixed:
 Rates are deltas between frames (cumulative ÷ uptime on the first
 frame / ``--once``). Pure stdlib, no jax — runs wherever the artifacts
 or endpoints are reachable, same contract as ``watch``/``report``.
+
+Three history-plane hooks (:mod:`.history`):
+
+* ``--store DIR`` — point the dashboard at a collector's (or recorded)
+  history store: every row gains a TREND sparkline of its recent rows/s
+  (``top_rows_per_sec`` falling back to the collector's
+  ``serve_rows_per_sec``, keyed by ``instance``);
+* ``--record DIR`` — write every rendered frame's samples into a store
+  in the history format (one ``append_samples`` batch per frame, one
+  shared timestamp), turning any live incident into a durable artifact;
+* ``--replay DIR`` — play a recorded session back frame by frame, no
+  daemons required: the post-incident review runs on the artifact.
 """
 
 from __future__ import annotations
@@ -207,6 +219,11 @@ class StatuszSource:
                     "busy": (
                         _share_cell(dom, share.get(dom)) if dom else None
                     ),
+                    "alerts": (
+                        [f"{int(b['alerts'])} firing"]
+                        if b.get("alerts")
+                        else []
+                    ),
                 }
             )
         fleet = fz.get("fleet") or {}
@@ -215,6 +232,9 @@ class StatuszSource:
         if shares:
             stage = max(sorted(shares), key=lambda k: shares[k]["share"])
             busy = _share_cell(stage, shares[stage]["share"])
+        # fleet-wide live alert count (summed per-backend SLO engines,
+        # pipeline.aggregate_fleet): the fleet row says "N firing"
+        n_alerts = int(fleet.get("alerts") or 0)
         rows.append(
             {
                 "run": (
@@ -225,6 +245,7 @@ class StatuszSource:
                 "rows": fleet.get("rows"),
                 "rows_per_sec": fleet.get("rows_per_sec"),
                 "busy": busy,
+                "alerts": [f"{n_alerts} firing"] if n_alerts else [],
             }
         )
         return rows
@@ -298,9 +319,121 @@ _COLUMNS = (
     ("QUAR", "quarantined", 7),
     ("WIRE", "wire", 16),
     ("BUSY", "busy", 14),
+    ("TREND", "trend", 14),
     ("AGE", "age_s", 7),
     ("ALERTS", "alerts", 0),
 )
+
+#: Numeric row columns a ``--record`` store captures (as ``top_<col>``
+#: series keyed by ``instance``) and ``--replay`` restores.
+_RECORD_COLS = (
+    "rows",
+    "rows_per_sec",
+    "p50_ms",
+    "p99_ms",
+    "detections",
+    "quarantined",
+    "age_s",
+)
+
+#: The trend sparkline's preferred series, most-specific first: a
+#: ``--record``ed store carries ``top_rows_per_sec``; a collector-built
+#: store carries the scraped ``serve_rows_per_sec``.
+_TREND_SERIES = ("top_rows_per_sec", "serve_rows_per_sec")
+
+
+def record_frame(store, rows: list[dict], *, ts=None) -> int:
+    """Append one rendered frame's samples to a history store (one
+    batch, one shared timestamp — replay regroups frames by it);
+    returns the sample count. Statuses ride as a label on ``top_up``
+    (history values are floats), alert *counts* on
+    ``top_alerts_active`` — the replayable skeleton of the frame."""
+    samples: list = []
+    for r in rows:
+        inst = str(r.get("run") or "?").strip()
+        samples.append(
+            (
+                "top_up",
+                {"instance": inst, "status": str(r.get("status") or "?")},
+                0.0 if r.get("status") == "down" else 1.0,
+            )
+        )
+        samples.append(
+            (
+                "top_alerts_active",
+                {"instance": inst},
+                float(len(r.get("alerts") or [])),
+            )
+        )
+        for key in _RECORD_COLS:
+            v = r.get(key)
+            if isinstance(v, (int, float)):
+                samples.append((f"top_{key}", {"instance": inst}, float(v)))
+    store.append_samples(samples, ts=ts)
+    return len(samples)
+
+
+def replay_frames(store_dir: str) -> "list[tuple[float, list[dict]]]":
+    """Reconstruct recorded frames from a ``--record`` store: samples
+    sharing one timestamp are one frame, one row per instance (insertion
+    order preserved — the order the dashboard rendered them in)."""
+    from .history import read_samples
+
+    frames: dict[float, dict[str, dict]] = {}
+    for rec in read_samples(store_dir):
+        name = rec["name"]
+        if not name.startswith("top_"):
+            continue
+        labels = rec.get("labels") or {}
+        inst = labels.get("instance", "?")
+        by_inst = frames.setdefault(float(rec["ts"]), {})
+        row = by_inst.setdefault(inst, {"run": inst, "alerts": []})
+        if name == "top_up":
+            row["status"] = labels.get("status", "?")
+        elif name == "top_alerts_active":
+            n = int(rec["value"])
+            row["alerts"] = [f"{n} firing"] if n else []
+        elif name[len("top_"):] in _RECORD_COLS:
+            key = name[len("top_"):]
+            v = rec["value"]
+            row[key] = int(v) if key in ("rows", "detections",
+                                         "quarantined") else v
+    return [
+        (ts, list(by_inst.values())) for ts, by_inst in sorted(frames.items())
+    ]
+
+
+class TrendSource:
+    """Per-instance rows/s sparklines from a history store (``--store``):
+    the dashboard's memory. Reads are torn-tail tolerant and fully
+    concurrent with a live collector writing the same store."""
+
+    def __init__(self, store_dir: str, *, window_s: float = 600.0,
+                 width: int = 12):
+        self.store_dir = store_dir
+        self.window_s = window_s
+        self.width = width
+
+    def cell(self, run: str, now: "float | None" = None) -> "str | None":
+        from .history import range_query, sparkline
+
+        if now is None:
+            now = time.time()
+        inst = str(run).strip()
+        for name in _TREND_SERIES:
+            series = range_query(
+                self.store_dir,
+                name,
+                labels={"instance": inst},
+                start=now - self.window_s,
+                end=now,
+            )
+            for pts in series.values():
+                if pts:
+                    return sparkline(
+                        [v for _, v in pts], width=self.width
+                    ) or None
+        return None
 
 
 def _cell(value) -> str:
@@ -347,9 +480,13 @@ def top(
     out=print,
     sleep=time.sleep,
     frames: "int | None" = None,
+    store: "str | None" = None,
+    record: "str | None" = None,
 ) -> int:
     """Drive the dashboard; returns an exit code (0 ok, 4 = nothing to
-    show — no resolvable log and no endpoint, the watch convention)."""
+    show — no resolvable log and no endpoint, the watch convention).
+    ``store`` adds the TREND sparkline column from a history store;
+    ``record`` appends every frame's samples to one."""
     sources: list = []
     for t in targets:
         path = resolve_log(t)
@@ -360,19 +497,56 @@ def top(
     sources.extend(StatuszSource(u) for u in statusz)
     if not sources:
         return 4
-    n = 0
-    while True:
-        now_mono = time.monotonic()
-        rows = []
-        for src in sources:
-            polled = src.poll(now_mono)
-            rows.extend(polled if isinstance(polled, list) else [polled])
-        frame = render(rows, time.time())
-        out(frame if once else _CLEAR + frame)
-        n += 1
-        if once or (frames is not None and n >= frames):
-            return 0
-        sleep(interval)
+    trend = TrendSource(store) if store else None
+    recorder = None
+    if record:
+        from .history import HistoryStore
+
+        recorder = HistoryStore(record)
+    try:
+        n = 0
+        while True:
+            now_mono = time.monotonic()
+            now = time.time()
+            rows = []
+            for src in sources:
+                polled = src.poll(now_mono)
+                rows.extend(polled if isinstance(polled, list) else [polled])
+            if trend is not None:
+                for r in rows:
+                    r["trend"] = trend.cell(r.get("run") or "?", now=now)
+            if recorder is not None:
+                record_frame(recorder, rows, ts=now)
+            frame = render(rows, now)
+            out(frame if once else _CLEAR + frame)
+            n += 1
+            if once or (frames is not None and n >= frames):
+                return 0
+            sleep(interval)
+    finally:
+        if recorder is not None:
+            recorder.close()
+
+
+def replay(
+    store_dir: str,
+    *,
+    interval: float = 0.0,
+    out=print,
+    sleep=time.sleep,
+    clear: bool = False,
+) -> int:
+    """Play a ``--record``ed session back frame by frame (exit 4 when
+    the store holds no frames — the nothing-to-show convention)."""
+    recorded = replay_frames(store_dir)
+    if not recorded:
+        return 4
+    for i, (ts, rows) in enumerate(recorded):
+        frame = render(rows, ts)
+        out(_CLEAR + frame if clear else frame)
+        if interval > 0 and i < len(recorded) - 1:
+            sleep(interval)
+    return 0
 
 
 def main(argv=None) -> None:
@@ -398,7 +572,29 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--once", action="store_true", help="print one frame and exit"
     )
+    ap.add_argument(
+        "--store", default=None, metavar="DIR",
+        help="history store (telemetry.history): adds the TREND "
+        "rows/s sparkline column per row",
+    )
+    ap.add_argument(
+        "--record", default=None, metavar="DIR",
+        help="append every rendered frame's samples to a history store "
+        "— the incident becomes a replayable artifact",
+    )
+    ap.add_argument(
+        "--replay", default=None, metavar="DIR",
+        help="play a --record'ed session back frame by frame and exit "
+        "(no daemons; ignores targets/--statusz)",
+    )
     args = ap.parse_args(argv)
+    if args.replay:
+        if args.record:
+            ap.error("--replay plays an existing store; drop --record")
+        raise SystemExit(
+            replay(args.replay, interval=args.interval if not args.once
+                   else 0.0, clear=not args.once)
+        )
     if not args.targets and not args.statusz:
         ap.error("nothing to watch: give a run log/dir or --statusz URL")
     raise SystemExit(
@@ -407,6 +603,8 @@ def main(argv=None) -> None:
             args.statusz,
             interval=args.interval,
             once=args.once,
+            store=args.store,
+            record=args.record,
         )
     )
 

@@ -42,9 +42,13 @@ def test_quickstart_example(tmp_path):
     assert (tmp_path / "ddm_cluster_runs.csv").exists()
 
 
+@pytest.mark.slow
 def test_detector_zoo_example(tmp_path):
     # tiny geometry (mult=1, 4 partitions): the assertion is that every zoo
-    # member runs and reports, not detection quality — keep the fast tier fast
+    # member runs and reports, not detection quality. Slow tier: each member
+    # is a fresh XLA compile in the subprocess (~1 min for the family), and
+    # every detector is fast-tier-covered in-process (test_detectors,
+    # test_chunked's zoo parametrizations) — this adds only script wiring.
     out = run_example(tmp_path, "detector_zoo.py", "synth:rialto,seed=0", 1, 4)
     for name in ("ddm", "ph", "eddm", "hddm", "hddm_w", "adwin", "kswin", "stepd"):
         # row-anchored: "hddm_w"/"eddm" contain "hddm"/"ddm" as substrings,
@@ -52,8 +56,11 @@ def test_detector_zoo_example(tmp_path):
         assert f"\n{name} " in out, f"detector {name} row missing:\n{out}"
 
 
+@pytest.mark.slow
 def test_model_zoo_example(tmp_path):
-    # same contract as the detector zoo: every family runs and reports
+    # same contract (and same slow-tier rationale) as the detector zoo:
+    # every family runs and reports; each is a fresh subprocess compile,
+    # and all model families are fast-tier-covered in test_models.
     out = run_example(tmp_path, "model_zoo.py", "synth:rialto,seed=0", 1, 4)
     for name in (
         "majority", "centroid", "gnb", "linear", "linear@robust", "mlp",

@@ -1,0 +1,715 @@
+"""History plane contracts (ISSUE 17): the durable time-series store,
+the fleet collector, burn-rate SLO rules, per-tenant hotness, windowed
+pipeline attribution and the top record/replay surfaces.
+
+The centerpiece is the seeded property test over the store: randomized
+append batches through segment rotation, then downsampled queries —
+values conserved exactly under ``agg='sum'``, timestamps monotone, a
+torn final record skipped exactly once. Everything else pins the
+contracts the history-smoke CI job drives end to end: collector
+down-marking, the skew-rebase rate convention, the multi-window
+burn-rate state machine (and its ``slo_alert_active`` gauges), and the
+``pipeline --window`` attribution cross-checked against the live one.
+"""
+
+import http.server
+import json
+import os
+import random
+import socket
+import threading
+
+import pytest
+
+from distributed_drift_detection_tpu.telemetry import history
+from distributed_drift_detection_tpu.telemetry import pipeline as pl
+from distributed_drift_detection_tpu.telemetry import top as topmod
+from distributed_drift_detection_tpu.telemetry.collector import (
+    Target,
+    _normalize_base,
+    discover,
+    scrape_once,
+)
+from distributed_drift_detection_tpu.telemetry.history import HistoryStore
+from distributed_drift_detection_tpu.telemetry.metrics import MetricsRegistry
+from distributed_drift_detection_tpu.telemetry.slo import (
+    ALERT_ACTIVE_METRIC,
+    SloEngine,
+    parse_rules,
+    rule_name,
+)
+
+# ---------------------------------------------------------------------------
+# The store: seeded property test over append → rotate → downsample → query
+# ---------------------------------------------------------------------------
+
+
+def test_store_property_roundtrip(tmp_path):
+    """Randomized batches across many rotations: every sample survives,
+    per-series timestamps are monotone, and step-aligned ``sum`` buckets
+    conserve the raw total exactly."""
+    rng = random.Random(1234)
+    root = str(tmp_path / "store")
+    names = ("alpha_total", "beta_gauge")
+    written = []  # (name, labels, ts, value)
+    with HistoryStore(root, segment_bytes=700) as store:
+        ts = 1_000.0
+        for _ in range(rng.randrange(60, 90)):
+            ts += rng.uniform(0.1, 5.0)
+            batch = [
+                (
+                    rng.choice(names),
+                    {"instance": f"i{rng.randrange(3)}"},
+                    round(rng.uniform(-50, 50), 3),
+                )
+                for _ in range(rng.randrange(1, 6))
+            ]
+            store.append_samples(batch, ts=ts, mono=ts - 1_000.0)
+            written.extend((n, l["instance"], ts, v) for n, l, v in batch)
+    assert len(history.list_segments(root)) > 5  # rotation really happened
+
+    recs = history.read_samples(root)
+    assert [
+        (r["name"], r["labels"]["instance"], r["ts"], r["value"])
+        for r in recs
+    ] == [(n, i, round(ts, 6), v) for n, i, ts, v in written]
+
+    for name in names:
+        for pts in history.range_query(root, name).values():
+            stamps = [t for t, _ in pts]
+            assert stamps == sorted(stamps)
+
+    # conservation: sum of step-aligned sum-buckets == raw sum, exactly
+    for name in names:
+        raw = sum(v for n, _, _, v in written if n == name)
+        bucketed = sum(
+            v
+            for pts in history.range_query(
+                root, name, step=7.0, agg="sum"
+            ).values()
+            for _, v in pts
+        )
+        assert bucketed == pytest.approx(raw, abs=1e-9)
+        # and bucket timestamps are step-aligned
+        for pts in history.range_query(root, name, step=7.0, agg="sum").values():
+            assert all(t % 7.0 == 0.0 for t, _ in pts)
+
+
+def test_torn_tail_skipped_exactly_once(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for i in range(5):
+            store.append("c_total", float(i), ts=100.0 + i, mono=float(i))
+    seg = history.list_segments(root)[-1]
+    with open(seg, "rb+") as fh:
+        data = fh.read()
+        fh.truncate(len(data) - 9)  # tear the final record mid-JSON
+    recs = history.read_samples(root, name="c_total")
+    assert [r["value"] for r in recs] == [0.0, 1.0, 2.0, 3.0]  # one skipped
+
+    # a resumed WRITER truncates the torn tail before appending, so the
+    # next sample cannot concatenate into a corrupt interior line
+    with HistoryStore(root) as store:
+        store.append("c_total", 9.0, ts=110.0, mono=9.0)
+    recs = history.read_samples(root, name="c_total")
+    assert [r["value"] for r in recs] == [0.0, 1.0, 2.0, 3.0, 9.0]
+
+
+def test_interior_corruption_raises(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for i in range(3):
+            store.append("c_total", float(i), ts=100.0 + i)
+    seg = history.list_segments(root)[-1]
+    lines = open(seg).read().splitlines()
+    lines[1] = lines[1][:20]  # corrupt an INTERIOR record
+    with open(seg, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="corrupt history record"):
+        history.read_samples(root, name="c_total")
+
+
+def test_retention_by_age_and_size(tmp_path):
+    root = str(tmp_path / "store")
+    store = HistoryStore(root, segment_bytes=256, retention_s=50.0)
+    for i in range(40):
+        store.append("c_total", float(i), ts=1_000.0 + i * 5.0, mono=i * 5.0)
+    now = 1_000.0 + 39 * 5.0
+    deleted = store.enforce_retention(now=now)
+    assert deleted
+    # the active segment always survives; surviving samples are young
+    active = history.segment_path(root, store._seq)
+    assert os.path.exists(active)
+    recs = history.read_samples(root, name="c_total")
+    assert recs  # never empties the store
+    # finalized survivors end within the age bound
+    for seg in history.list_segments(root)[:-1]:
+        assert history._segment_bounds(seg)[1] >= now - 50.0
+    store.close()
+
+    # size bound: total finalized+active size shrinks under the cap
+    root2 = str(tmp_path / "store2")
+    store2 = HistoryStore(root2, segment_bytes=256, retention_bytes=1_000)
+    for i in range(60):
+        store2.append("c_total", float(i), ts=2_000.0 + i)
+    store2.enforce_retention(now=2_100.0)
+    total = sum(
+        os.path.getsize(p) for p in history.list_segments(root2)
+    )
+    assert total <= 1_000 + 256  # cap plus at most one active segment
+    store2.close()
+
+
+# ---------------------------------------------------------------------------
+# Query primitives: rate (+ skew rebase), quantile, hotness ranking
+# ---------------------------------------------------------------------------
+
+
+def test_rate_counter_reset_tolerant(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        # 0 → 100 → (restart) 10 → 30: positive deltas sum to 120, the
+        # reset itself contributes nothing (never a negative rate)
+        for mono, v in ((0.0, 0.0), (10.0, 100.0), (20.0, 10.0), (30.0, 30.0)):
+            store.append("c_total", v, ts=1_000.0 + mono, mono=mono)
+    rates = history.rate(root, "c_total", window_s=300.0, at=1_030.0)
+    assert rates[()] == pytest.approx(120.0 / 30.0)
+
+
+def test_rate_skew_rebase(tmp_path):
+    """Within one writer boot elapsed time is MONOTONIC — a wall-clock
+    step between scrapes cannot fake or hide a rate; across boots only
+    wall time is shared."""
+    root = str(tmp_path / "store")
+    with HistoryStore(root, boot="boot-a") as store:
+        store.append("c_total", 0.0, ts=1_000.0, mono=5.0)
+        # wall leaps 1000s (NTP step); monotonic says 10s really passed
+        store.append("c_total", 100.0, ts=2_000.0, mono=15.0)
+    rates = history.rate(root, "c_total", window_s=5_000.0, at=2_000.0)
+    assert rates[()] == pytest.approx(10.0)  # 100 / 10 mono-seconds
+
+    root2 = str(tmp_path / "store2")
+    with HistoryStore(root2, boot="boot-a") as store:
+        store.append("c_total", 0.0, ts=1_000.0, mono=5.0)
+    with HistoryStore(root2, boot="boot-b") as store:
+        store.append("c_total", 100.0, ts=1_050.0, mono=2.0)
+    rates = history.rate(root2, "c_total", window_s=5_000.0, at=1_050.0)
+    assert rates[()] == pytest.approx(2.0)  # different boots → wall: 100/50
+
+
+def test_quantile_and_avg_over_time(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+            store.append("g", v, ts=100.0 + i)
+    assert history.quantile_over_time(root, "g", 0.5, at=104.0)[()] == 2.5
+    assert history.quantile_over_time(root, "g", 1.0, at=104.0)[()] == 4.0
+    assert history.avg_over_time(root, "g", at=104.0)[()] == 2.5
+    with pytest.raises(ValueError):
+        history.quantile_over_time(root, "g", 1.5)
+
+
+def test_top_tenants_ranking(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for mono in (0.0, 10.0):
+            store.append_samples(
+                [
+                    (history.TENANT_ROWS_METRIC,
+                     {"tenant": "0", "instance": "a"}, mono * 30.0),
+                    (history.TENANT_ROWS_METRIC,
+                     {"tenant": "1", "instance": "a"}, mono * 10.0),
+                    # tenant 2 split across two instances: rates sum
+                    (history.TENANT_ROWS_METRIC,
+                     {"tenant": "2", "instance": "a"}, mono * 25.0),
+                    (history.TENANT_ROWS_METRIC,
+                     {"tenant": "2", "instance": "b"}, mono * 25.0),
+                    (history.TENANT_ADAPT_METRIC,
+                     {"tenant": "1", "instance": "a"}, mono * 0.5),
+                ],
+                ts=1_000.0 + mono,
+                mono=mono,
+            )
+    ranked = history.top_tenants(root, window_s=300.0, at=1_010.0)
+    assert [r["tenant"] for r in ranked] == ["2", "0", "1"]
+    assert ranked[0]["rows_per_sec"] == pytest.approx(50.0)
+    assert ranked[2]["adaptations_per_sec"] == pytest.approx(0.5)
+    assert history.top_tenants(root, at=1_010.0, limit=1) == ranked[:1]
+
+
+def test_sparkline():
+    assert history.sparkline([]) == ""
+    assert history.sparkline([None, None]) == ""
+    assert history.sparkline([1.0, 1.0]) == "▁▁"
+    s = history.sparkline([0.0, None, 10.0])
+    assert s[0] == "▁" and s[1] == " " and s[2] == "█"
+    assert len(history.sparkline(range(100), width=12)) == 12
+
+
+# ---------------------------------------------------------------------------
+# The history CLI
+# ---------------------------------------------------------------------------
+
+
+def test_history_cli(tmp_path, capsys):
+    root = str(tmp_path / "store")
+    assert history.main(["rate", root, "c_total"]) == 4  # no store
+
+    with HistoryStore(root) as store:
+        store.append("c_total", 0.0, ts=1_000.0, mono=0.0,
+                     labels={"instance": "a"})
+        store.append("c_total", 50.0, ts=1_010.0, mono=10.0,
+                     labels={"instance": "a"})
+    capsys.readouterr()
+
+    assert history.main(
+        ["rate", root, "c_total", "--at", "1010", "--json"]
+    ) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out['{instance="a"}'] == pytest.approx(5.0)
+
+    assert history.main(
+        ["range", root, "c_total", "--at", "1010", "--label", "instance=a"]
+    ) == 0
+    assert "c_total" in capsys.readouterr().out
+
+    assert history.main(["series", root]) == 0
+    assert 'c_total{instance="a"}' in capsys.readouterr().out
+
+    # empty result → 3 (the nothing-to-show convention)
+    assert history.main(
+        ["rate", root, "nope_total", "--at", "1010"]
+    ) == 3
+    assert history.main(["top-tenants", root, "--at", "1010"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# Collector: scraping, down-marking, discovery normalization
+# ---------------------------------------------------------------------------
+
+
+class _FakeOps(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        m = MetricsRegistry()
+        m.counter("serve_rows_published", help="rows").inc(1234.0)
+        m.histogram("serve_row_latency_seconds", help="lat").observe(0.01)
+        if self.path == "/metrics":
+            body = m.to_prometheus_text().encode()
+            ctype = "text/plain"
+        elif self.path == "/statusz":
+            body = json.dumps(
+                {
+                    "rows_per_sec": 321.5,
+                    "last_verdict_age_s": 0.25,
+                    "latency_ms": {"p99": 9.5},
+                    "alerts": [{"rule": "stall_s"}],
+                }
+            ).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def fake_ops():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeOps)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_collector_scrape_and_down_marking(tmp_path, fake_ops, capsys):
+    root = str(tmp_path / "store")
+    targets = [
+        Target("good", f"http://{fake_ops}"),
+        Target("dead", f"http://127.0.0.1:{_free_port()}"),
+    ]
+    metrics = MetricsRegistry()
+    with HistoryStore(root) as store:
+        summary = scrape_once(store, targets, metrics=metrics, timeout=5.0)
+    assert summary["targets"] == 2 and summary["up"] == 1
+    assert summary["errors"] == 1
+    assert "dead down" in capsys.readouterr().err
+
+    # up marking: 1 for the live target, 0 for the dead one
+    up = {
+        r["labels"]["instance"]: r["value"]
+        for r in history.read_samples(root, name="up")
+    }
+    assert up == {"good": 1.0, "dead": 0.0}
+
+    # /metrics samples land instance-labeled; histogram buckets do not
+    recs = history.read_samples(root, name="serve_rows_published")
+    assert recs and recs[0]["labels"]["instance"] == "good"
+    assert recs[0]["value"] == 1234.0
+    assert not history.read_samples(
+        root, name="serve_row_latency_seconds_bucket"
+    )
+    assert history.read_samples(
+        root, name="serve_row_latency_seconds_count"
+    )
+
+    # /statusz lifts + the live alert count
+    lifted = {
+        r["name"]: r["value"]
+        for r in history.read_samples(root, labels={"instance": "good"})
+    }
+    assert lifted["serve_rows_per_sec"] == 321.5
+    assert lifted["serve_p99_ms"] == 9.5
+    assert lifted["serve_alerts_active"] == 1.0
+
+    # self-metering rides the same store, and one shared stamp per cycle
+    assert history.read_samples(root, name="collector_scrape_seconds")
+    assert history.read_samples(root, name="collector_targets_up")[0][
+        "value"
+    ] == 1.0
+    assert len({(r["ts"], r["mono"]) for r in history.read_samples(root)}) == 1
+
+
+def test_discover_normalizes_and_dedupes(fake_ops):
+    assert _normalize_base("127.0.0.1:9100/statusz") == "http://127.0.0.1:9100"
+    assert _normalize_base("http://h:1/metrics") == "http://h:1"
+    targets = discover(
+        statusz_urls=[fake_ops, f"http://{fake_ops}/statusz"]
+    )
+    assert len(targets) == 1  # deduped by resolved base
+
+
+def test_collector_rejects_threshold_slo_rules(tmp_path):
+    from distributed_drift_detection_tpu.telemetry.collector import (
+        run_collector,
+    )
+
+    with pytest.raises(ValueError, match="burn_rate"):
+        run_collector(
+            str(tmp_path / "store"),
+            statusz_urls=["127.0.0.1:1"],
+            slo_specs=["stall_s=30"],
+            telemetry_dir=str(tmp_path / "tele"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate SLO rules
+# ---------------------------------------------------------------------------
+
+
+def test_parse_burn_rules():
+    rules = parse_rules(["burn_rate=p99_ms:250:30/300:1.5", "stall_s=30"])
+    assert len(rules) == 2
+    burn = rules[0]
+    assert burn.kind == "burn_rate" and burn.series == "p99_ms"
+    assert burn.objective == 250.0
+    assert (burn.fast_s, burn.slow_s, burn.threshold) == (30.0, 300.0, 1.5)
+    assert rule_name(burn) == "burn_rate:p99_ms"
+
+    for bad in (
+        "burn_rate=p99_ms:250:30:1.5",  # no FAST/SLOW pair
+        "burn_rate=p99_ms:0:30/300:1.5",  # objective must be > 0
+        "burn_rate=p99_ms:250:300/30:1.5",  # FAST must be < SLOW
+        "burn_rate=:250:30/300:1.5",  # empty series
+        "burn_rate=p99_ms:x:30/300:1.5",  # non-numeric
+    ):
+        with pytest.raises(ValueError):
+            parse_rules([bad])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules(
+            ["burn_rate=p99_ms:250:30/300:1", "burn_rate=p99_ms:100:5/50:2"]
+        )
+
+
+def test_burn_rate_multi_window_fire_and_resolve():
+    """The slow window vetoes a blip; a sustained burn fires; recovery
+    resolves — and the ``slo_alert_active`` gauge tracks every step."""
+    rules = parse_rules(["burn_rate=p99_ms:100:10/30:1.0"])
+    clock = {"t": 0.0}
+    metrics = MetricsRegistry()
+    engine = SloEngine(rules, metrics=metrics, now_fn=lambda: clock["t"])
+    gauge = metrics.gauge(ALERT_ACTIVE_METRIC)
+    gkey = (("rule", "burn_rate:p99_ms"),)
+    assert gauge.values[gkey] == 0.0  # pre-registered before any firing
+
+    events = []
+
+    def emit(etype, **fields):
+        events.append(fields)
+
+    def tick(value):
+        clock["t"] += 5.0
+        return engine.evaluate({"p99_ms": value}, emit)
+
+    for _ in range(7):  # healthy baseline fills both windows
+        assert tick(50.0) == []
+    # one blip: the fast window burns, the slow window vetoes
+    assert tick(160.0) == []
+    assert gauge.values[gkey] == 0.0
+    # sustained: both windows eventually burn → exactly one firing
+    fired = []
+    for _ in range(8):
+        fired += tick(160.0)
+    assert [t["state"] for t in fired] == ["firing"]
+    assert fired[0]["rule"] == "burn_rate:p99_ms"
+    assert engine.active() and gauge.values[gkey] == 1.0
+    # recovery drops the fast burn below the factor → one resolved
+    resolved = []
+    for _ in range(4):
+        resolved += tick(20.0)
+    assert [t["state"] for t in resolved] == ["resolved"]
+    assert not engine.active() and gauge.values[gkey] == 0.0
+    assert [e["state"] for e in events] == ["firing", "resolved"]
+
+
+def test_burn_rate_window_avg_fn_mode():
+    """Collector mode: windowed averages come from the store, and the
+    rule fires only when BOTH windows burn (min of the pair)."""
+    rules = parse_rules(["burn_rate=serve_p99_ms:100:30/300:1.0"])
+    avgs = {}
+    engine = SloEngine(
+        rules, window_avg_fn=lambda series, w: avgs.get(w)
+    )
+    assert engine.evaluate({}) == []  # windows empty → skipped
+    avgs.update({30.0: 500.0, 300.0: 50.0})  # blip: slow window vetoes
+    assert engine.evaluate({}) == []
+    avgs.update({30.0: 500.0, 300.0: 150.0})  # sustained
+    (t,) = engine.evaluate({})
+    assert t["state"] == "firing" and t["value"] == pytest.approx(1.5)
+    avgs.update({30.0: 20.0})
+    (t,) = engine.evaluate({})
+    assert t["state"] == "resolved"
+
+
+# ---------------------------------------------------------------------------
+# pipeline --window: attribution from the store, cross-checked vs live
+# ---------------------------------------------------------------------------
+
+
+def _scrape_registry_into(store, metrics, *, instance, ts, mono):
+    from distributed_drift_detection_tpu.telemetry.metrics import (
+        parse_prometheus_text,
+    )
+
+    samples = [
+        (name, {**dict(labels), "instance": instance}, value)
+        for (name, labels), value in sorted(
+            parse_prometheus_text(metrics.to_prometheus_text()).items()
+        )
+        if not name.endswith("_bucket")
+    ]
+    store.append_samples(samples, ts=ts, mono=mono)
+
+
+def test_window_report_matches_live_attribution(tmp_path):
+    """Two scrapes of a registry that started from zero: the windowed
+    busy deltas ARE the cumulative counters, so the ``--window`` report
+    must agree with the live ``attribute()`` fold cell for cell."""
+    root = str(tmp_path / "store")
+    metrics = MetricsRegistry()
+    busy = metrics.counter(pl.SERVE_STAGE_BUSY_METRIC, help="busy")
+    stages = (("feed", 2.0), ("device", 5.0), ("publish", 1.0))
+    for stage, _ in stages:  # pre-registered at 0, like the live daemon
+        busy.inc(0.0, stage=stage)
+    metrics.gauge(pl.SERVE_WALL_METRIC, help="wall").set(0.0)
+    metrics.counter(pl.SERVE_ROWS_METRIC, help="rows").inc(0.0)
+    with HistoryStore(root) as store:
+        _scrape_registry_into(
+            store, metrics, instance="d1", ts=1_000.0, mono=0.0
+        )
+        for stage, t in stages:
+            busy.inc(t, stage=stage)
+        metrics.gauge(pl.SERVE_WALL_METRIC, help="wall").set(10.0)
+        metrics.counter(pl.SERVE_ROWS_METRIC, help="rows").inc(4_000.0)
+        _scrape_registry_into(
+            store, metrics, instance="d1", ts=1_060.0, mono=60.0
+        )
+
+    live = pl.attribute(pl.serve_stage_breakdown(metrics), 10.0, 4_000)
+    windowed = pl.load_window_report(root, 300.0, at=1_060.0)
+    assert windowed["stages"] == live["stages"]
+    assert windowed["dominant_stage"] == live["dominant_stage"] == "device"
+    assert windowed["busy_total_s"] == live["busy_total_s"]
+    assert windowed["wall_s"] == live["wall_s"]
+    assert windowed["coverage"] == live["coverage"]
+    assert windowed["rows"] == live["rows"] == 4_000
+    assert windowed["window_s"] == 300.0
+
+
+def test_window_report_restart_and_ambiguity(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        # daemon restart mid-window: counter 8 → 3 counts from zero (3)
+        for ts, v in ((1_000.0, 8.0), (1_030.0, 3.0)):
+            store.append_samples(
+                [(pl.SERVE_STAGE_BUSY_METRIC,
+                  {"stage": "device", "instance": "d1"}, v)],
+                ts=ts, mono=ts,
+            )
+        store.append_samples(
+            [(pl.SERVE_STAGE_BUSY_METRIC,
+              {"stage": "device", "instance": "d2"}, 1.0)],
+            ts=1_030.0, mono=1_030.0,
+        )
+    with pytest.raises(ValueError, match="--instance"):
+        pl.load_window_report(root, 300.0, at=1_030.0)
+    rep = pl.load_window_report(root, 300.0, instance="d1", at=1_030.0)
+    assert rep["stages"]["device"]["busy_s"] == 3.0
+    assert rep["instance"] == "d1"
+    with pytest.raises(ValueError, match="no serve_stage_busy"):
+        pl.load_window_report(root, 1.0, instance="d1", at=9_999.0)
+
+
+def test_pipeline_cli_window_flags(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        pl.main(["--instance", "d1", str(tmp_path)])  # needs --window
+    capsys.readouterr()
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for ts, v in ((1_000.0, 0.0), (1_030.0, 6.0)):
+            store.append_samples(
+                [(pl.SERVE_STAGE_BUSY_METRIC,
+                  {"stage": "device", "instance": "d1"}, v)],
+                ts=ts, mono=ts,
+            )
+    rc = pl.main(
+        [root, "--window", "300", "--at", "1030", "--json"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["dominant_stage"] == "device"
+    assert out["window_s"] == 300.0
+
+
+# ---------------------------------------------------------------------------
+# top: record → replay round-trip, TREND sparklines
+# ---------------------------------------------------------------------------
+
+
+def test_top_record_replay_roundtrip(tmp_path):
+    root = str(tmp_path / "frames")
+    rows = [
+        {
+            "run": "d1", "status": "live", "rows": 500,
+            "rows_per_sec": 100.0, "p99_ms": 9.0, "detections": 2,
+            "alerts": ["stall_s 31.0>30"],
+        },
+        {"run": "d2", "status": "down", "alerts": []},
+    ]
+    with HistoryStore(root) as store:
+        topmod.record_frame(store, rows, ts=1_000.0)
+        rows[0]["rows"] = 900
+        rows[0]["alerts"] = []
+        topmod.record_frame(store, rows, ts=1_002.0)
+    frames = topmod.replay_frames(root)
+    assert len(frames) == 2
+    ts0, rows0 = frames[0]
+    assert ts0 == 1_000.0
+    by_run = {r["run"]: r for r in rows0}
+    assert by_run["d1"]["status"] == "live"
+    assert by_run["d1"]["rows"] == 500 and by_run["d1"]["p99_ms"] == 9.0
+    assert by_run["d1"]["alerts"] == ["1 firing"]
+    assert by_run["d2"]["status"] == "down"
+    assert frames[1][1][0]["rows"] == 900
+    assert frames[1][1][0]["alerts"] == []
+
+    shown = []
+    assert topmod.replay(root, out=shown.append) == 0
+    assert len(shown) == 2 and "d1" in shown[0]
+    assert topmod.replay(str(tmp_path / "empty")) == 4
+
+
+def test_top_trend_cell(tmp_path):
+    root = str(tmp_path / "store")
+    with HistoryStore(root) as store:
+        for i in range(6):
+            store.append(
+                "serve_rows_per_sec", float(i * 100),
+                labels={"instance": "d1"}, ts=1_000.0 + i, mono=float(i),
+            )
+    trend = topmod.TrendSource(root, window_s=600.0, width=6)
+    cell = trend.cell("d1", now=1_006.0)
+    assert cell and len(cell) == 6
+    assert cell[0] == "▁" and cell[-1] == "█"
+    assert trend.cell("ghost", now=1_006.0) is None
+
+
+def test_render_has_trend_column_and_alert_rollup():
+    out = topmod.render(
+        [
+            {"run": "d1", "status": "live", "trend": "▁▂█",
+             "alerts": ["1 firing"]},
+        ],
+        1_000.0,
+    )
+    assert "TREND" in out and "▁▂█" in out
+    assert "1 run(s) with active alerts" in out
+
+
+# ---------------------------------------------------------------------------
+# loadgen: smooth weighted round-robin dealing
+# ---------------------------------------------------------------------------
+
+
+class _SinkSocket:
+    def __init__(self):
+        self.data = b""
+
+    def sendall(self, b):
+        self.data += b
+
+    def close(self):
+        pass
+
+
+def test_loadgen_weighted_dealing(monkeypatch):
+    from distributed_drift_detection_tpu.serve import loadgen
+
+    sink = _SinkSocket()
+    monkeypatch.setattr(loadgen, "_connect", lambda *a, **k: sink)
+    lines = [f"{i},0" for i in range(100)]
+    summary = loadgen._run_loadgen_tenants(
+        "127.0.0.1", 1, lines, 3, interleave=10, weights=[3.0, 1.0, 1.0]
+    )
+    # 10 blocks of 10 rows: smooth WRR gives exact 3:1:1 shares
+    assert summary["tenant_rows_sent"] == [60, 20, 20]
+    # deterministic: the same weights deal the same wire stream
+    sink2 = _SinkSocket()
+    monkeypatch.setattr(loadgen, "_connect", lambda *a, **k: sink2)
+    loadgen._run_loadgen_tenants(
+        "127.0.0.1", 1, lines, 3, interleave=10, weights=[3.0, 1.0, 1.0]
+    )
+    assert sink2.data == sink.data
+    # and maximally interleaved, not front-loaded: the first four blocks
+    # visit tenant 0 twice, tenants 1 and 2 once (nginx smooth-WRR order)
+    tenants_in_order = [
+        int(ln.split()[1])
+        for ln in sink.data.decode().splitlines()
+        if ln.startswith("TENANT")
+    ]
+    assert tenants_in_order[:5] == [0, 1, 0, 2, 0]
+
+    with pytest.raises(ValueError, match="positive"):
+        loadgen._run_loadgen_tenants(
+            "127.0.0.1", 1, lines, 3, weights=[1.0, -1.0, 1.0]
+        )
+    with pytest.raises(ValueError):
+        loadgen.run_loadgen(
+            "127.0.0.1", 1, lines, tenant_weights=[1.0]
+        )

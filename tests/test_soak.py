@@ -353,12 +353,14 @@ def test_chained_soak_checkpoint_geometry_mismatch(tmp_path):
     assert resumed.rows_processed >= resumed.requested_rows
 
 
+@pytest.mark.slow
 def test_chained_soak_checkpoint_accepts_pre_paper_exact_eddm(tmp_path):
     """Migration shim: an eddm checkpoint written before EDDMParams grew
     ``paper_exact`` recorded a 3-float detector_params tuple; the default
     (paper_exact=False) kernel is bit-identical to the pre-r04 one, so such
     a checkpoint must resume rather than misdiagnose a geometry mismatch —
-    while an exact-mode resume still fails loudly."""
+    while an exact-mode resume still fails loudly. Slow tier: the shimmed
+    format is frozen, and the ~16 s cost is all soak-runner compile."""
     import json as _json
 
     from distributed_drift_detection_tpu.config import EDDMParams

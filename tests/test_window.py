@@ -92,8 +92,20 @@ def test_window_runner_matches_sequential(window, model_name):
     np.testing.assert_array_equal(_flags_to_array(win), _flags_to_array(seq))
 
 
-@pytest.mark.parametrize("rotations", [2, 4, 11])
-@pytest.mark.parametrize("window", [3, 16, 64])
+@pytest.mark.parametrize(
+    "window,rotations",
+    [
+        (3, 2), (3, 4), (3, 11),
+        (16, 2), (16, 4),
+        (64, 2), (64, 4),
+        # deep-speculation × wide-window corners are the two heaviest
+        # compiles in the fast tier (~45 s together); (3, 11) pins max
+        # depth and (16|64, 2|4) pin each width, so only the combined
+        # corners ride in the slow tier
+        pytest.param(16, 11, marks=pytest.mark.slow),
+        pytest.param(64, 11, marks=pytest.mark.slow),
+    ],
+)
 def test_multi_rotation_speculation_matches_sequential(window, rotations):
     """Speculation depth > 1 (rotate-and-replay inside one step) commits
     bit-identical flags to the sequential engine for every (W, R) — the
