@@ -1649,6 +1649,74 @@ def history_bench(batches: int, series: int) -> None:
     )
 
 
+def _incident_capture_stats(reps: int = 5) -> dict:
+    """--smoke rider: the incident-autopsy capture span (telemetry.incident,
+    jax-free). One :class:`IncidentRecorder` with realistic evidence
+    sources — a full 512-event flight ring, statusz/pipeline snapshots,
+    a 256-record verdict sidecar to tail — captures ``reps`` bundles and
+    the cell is the median wall-clock per capture. Informational in the
+    perf CLI: the capture runs on the SLO evaluator thread, off the serve
+    hot loop (the sidecar bit-parity test owns that claim), so this cell
+    is about keeping the off-loop cost visible round over round, not
+    about gating throughput."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from distributed_drift_detection_tpu.telemetry.incident import (
+        IncidentRecorder,
+    )
+    from distributed_drift_detection_tpu.telemetry.ops import FlightRecorder
+
+    root = tempfile.mkdtemp(prefix="incident_bench_")
+    try:
+        stem = os.path.join(root, "bench-run")
+        with open(stem + ".verdicts.jsonl", "w") as fh:
+            for i in range(256):
+                fh.write(
+                    json.dumps({"kind": "verdict", "chunk": i, "rows": 6400})
+                    + "\n"
+                )
+        flight = FlightRecorder(capacity=512)
+        for i in range(512):
+            flight.record(
+                {"type": "heartbeat", "i": i, "rows_per_sec": 1e5}
+            )
+        rec = IncidentRecorder(
+            stem,
+            flight=flight,
+            statusz_fn=lambda: {
+                "rows": {"ingress_seen": 10_000, "quarantined": 3},
+                "alerts": [],
+            },
+            pipeline_fn=lambda: {
+                "busy_s": {"device": 3.0, "publish": 0.4},
+                "wall_s": 4.0,
+                "shares": {"device": 0.75, "publish": 0.1},
+                "dominant_stage": "device",
+                "current_stage": {"stage": "device", "for_s": 0.1},
+            },
+            verdicts_path=stem + ".verdicts.jsonl",
+            max_bundles=reps + 1,
+        )
+        reason = {"rule": "stall_s", "state": "firing", "value": 1.0,
+                  "threshold": 0.4}
+        rec.capture(reason)  # warm (dir creation, allocator, page cache)
+        spans = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rec.capture(reason)
+            spans.append(time.perf_counter() - t0)
+        return {
+            "serve_incident_capture_ms": round(
+                statistics.median(spans) * 1000.0, 3
+            ),
+            "serve_incident_capture_reps": reps,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def smoke() -> None:
     """--smoke mode: the CI-scale artifact-contract check — the headline
     measurement pipeline on the self-contained synthetic rialto stand-in
@@ -1674,11 +1742,22 @@ def smoke() -> None:
         results_csv="",
         **({"collect": _CLI["collect"]} if _CLI["collect"] else {}),
     )
+    # Incident-autopsy rider (jax-free; must not take down the contract
+    # check — recorded in its own error field on failure, like the serve
+    # riders).
+    try:
+        inc = _incident_capture_stats()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        inc = {"serve_incident_error": f"{type(e).__name__}: {e}"[:300]}
     _emit(
         {
             "metric": "rows_per_sec_chip",
             "smoke": True,
             **_headline_core(prepare(cfg), reps=3),
+            **inc,
             "device": str(jax.devices()[0].platform),
         }
     )

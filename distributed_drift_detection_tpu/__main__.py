@@ -70,6 +70,7 @@ runs experiments):
     python -m distributed_drift_detection_tpu correlate <DIR | logs...>
     python -m distributed_drift_detection_tpu timeline <DIR | logs...> [-o OUT]
     python -m distributed_drift_detection_tpu explain <DIR | run.jsonl | bundle>
+    python -m distributed_drift_detection_tpu incident <list|show|diagnose> <DIR | run.jsonl | bundle>
     python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR [...]
     python -m distributed_drift_detection_tpu sched [SPEC] --telemetry-dir DIR [...]
     python -m distributed_drift_detection_tpu sched-worker --connect HOST:PORT [...]
@@ -114,7 +115,10 @@ multi-host fleet's per-process logs, clock-skew aligned) into a
 Chrome-trace/Perfetto ``.trace.json`` with the causal serving span
 chains (telemetry.timeline); ``explain`` renders the drift evidence
 bundles a serving daemon extracted under ``<run>.forensics/``
-(telemetry.forensics).
+(telemetry.forensics); ``incident`` lists/renders/diagnoses the
+alert-triggered cross-plane autopsy bundles under
+``<run>.incidents/`` — ``diagnose`` ranks probable causes
+deterministically from the bundle alone (telemetry.incident).
 """
 
 import sys
@@ -138,6 +142,7 @@ _USAGE = (
     "       python -m distributed_drift_detection_tpu correlate DIR_OR_LOGS\n"
     "       python -m distributed_drift_detection_tpu timeline DIR_OR_LOGS [-o OUT]\n"
     "       python -m distributed_drift_detection_tpu explain DIR_OR_LOG_OR_BUNDLE\n"
+    "       python -m distributed_drift_detection_tpu incident list|show|diagnose DIR_OR_LOG_OR_BUNDLE [...]\n"
     "       python -m distributed_drift_detection_tpu heal SPEC --telemetry-dir DIR\n"
     "       python -m distributed_drift_detection_tpu sched [SPEC] --telemetry-dir DIR [...]\n"
     "       python -m distributed_drift_detection_tpu sched-worker --connect HOST:PORT [...]\n"
@@ -224,6 +229,13 @@ def main(argv: list[str]) -> None:
 
         explain_main(argv[1:])
         return
+    if argv and argv[0] == "incident":
+        # jax-free: incident autopsy bundles (alert-triggered cross-plane
+        # evidence, <run-log>.incidents/) list/render/diagnose wherever
+        # the artifacts land (telemetry.incident).
+        from .telemetry.incident import main as incident_main
+
+        raise SystemExit(incident_main(argv[1:]))
     if argv and argv[0] == "heal":
         # jax-free in plan mode; --execute pulls in the api lazily.
         from .resilience.heal import main as heal_main

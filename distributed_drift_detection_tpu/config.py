@@ -392,6 +392,25 @@ class ServeParams(NamedTuple):
     # every tenant: verdicts only publish — today's behaviour,
     # byte-identical (no adaptation code runs at all).
     on_drift: tuple = ()
+    # --- incident autopsy plane (telemetry.incident) ---
+    # Alert-triggered cross-plane evidence capture: when an SLO alert
+    # fires (or the daemon crashes), snapshot the flight ring, pipeline
+    # attribution, /statusz, verdict/quarantine tails, and (with a
+    # history store) the recent fleet window into one numbered
+    # `<run-log>.incidents/incident-NNNN/` bundle — captured on the SLO
+    # evaluator thread, never the serve loop; verdict sidecars are
+    # bit-identical either way. Requires a telemetry dir (bundles
+    # anchor to the run-log stem); False (--no-incidents) disables.
+    incidents: bool = True
+    # Bundle cap per run: alert flapping must not fill the disk —
+    # captures beyond this are counted (`skipped`), not written.
+    incident_max: int = 32
+    # History-store directory (the collector's --store): when set, each
+    # bundle also extracts the recent time-series window + top-tenant
+    # ranking. '' = no history extract (a solo daemon has no store).
+    incident_store: str = ""
+    # Seconds of history extracted into each bundle.
+    incident_window_s: float = 120.0
 
 
 @dataclasses.dataclass(frozen=True)

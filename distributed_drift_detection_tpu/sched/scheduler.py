@@ -390,6 +390,9 @@ class Scheduler:
                     "rows_per_sec": round(
                         w.rows_done / max(now - w.joined_mono, 1e-9), 3
                     ),
+                    # workers have no incident plane (no ops endpoint to
+                    # capture from); the fleet INC column reads 0 here
+                    "incidents": 0,
                 }
                 for w in self.workers.values()
             ]

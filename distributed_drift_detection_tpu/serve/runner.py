@@ -207,9 +207,17 @@ class ServeRunner:
         self._slo_stop = None
         self._slo_thread = None
         self._recorder = None
+        self._incidents = None  # telemetry.incident.IncidentRecorder
         self._compile_info: dict = {}
         self._last_pub_mono: "float | None" = None
         self._loop_mono: "float | None" = None  # serve-loop liveness stamp
+        # Wedged-stage breadcrumb: (stage name, mono stamp) set at every
+        # stage boundary REUSING the boundary's existing clock read —
+        # zero extra hot-loop clock calls. Mid-stall the busy counters
+        # haven't been credited yet (they land when the stage *ends*),
+        # so an incident capture needs this to name the stage the loop
+        # is wedged IN, not the one that last finished.
+        self._loop_stage: "tuple[str, float] | None" = None
         # Pipeline observatory (telemetry.pipeline): stage busy clock,
         # wall/rows gauges, per-chunk stage-span tracer. All None when
         # params.pipeline_metrics is off — every touch point guards.
@@ -600,6 +608,27 @@ class ServeRunner:
         # metrics= exports slo_alert_active{rule} gauges: a scraper (the
         # collector, top) sees live alert state, not just the log tail.
         self._slo = SloEngine(rules, metrics=self._metrics)
+        # Incident autopsy plane: alert-triggered cross-plane evidence
+        # capture (telemetry.incident). Rides the SLO evaluator thread
+        # via the engine's observer hook — zero serve-loop work, and the
+        # verdict sidecars stay bit-identical with it on or off. Needs a
+        # run log (the bundle root is the run-log stem); a log-less
+        # embed simply has no incident plane.
+        if params.incidents and self._log is not None:
+            from ..telemetry.incident import IncidentRecorder
+
+            self._incidents = IncidentRecorder(
+                stem,
+                flight=self._recorder,
+                statusz_fn=self._statusz,
+                pipeline_fn=self.pipeline_snapshot,
+                verdicts_path=self.verdicts_path,
+                store=params.incident_store or None,
+                window_s=params.incident_window_s,
+                metrics=self._metrics,
+                max_bundles=params.incident_max,
+            )
+            self._slo.observer = self._incidents.on_transition
         if rules:
             self._slo_thread, self._slo_stop = start_evaluator(
                 self._slo,
@@ -616,6 +645,11 @@ class ServeRunner:
                 metrics_fn=self._metrics.to_prometheus_text,
                 health_fn=self._health,
                 status_fn=self._statusz,
+                incidentz_fn=(
+                    self._incidents.incidentz
+                    if self._incidents is not None
+                    else None
+                ),
             )
             self._ops.start()
             if self._log is not None and cfg.telemetry_dir:
@@ -912,7 +946,11 @@ class ServeRunner:
             "alerts": alerts,
             "poisoned": None if poisoned is None else repr(poisoned),
         }
-        if any(a.get("rule") in ("stall_s", "p99_ms") for a in alerts):
+        if any(
+            a.get("rule") in ("stall_s", "p99_ms")
+            or str(a.get("rule", "")).startswith("burn_rate:")
+            for a in alerts
+        ):
             # A wedged/slow loop names its dominant stage right in the
             # health body — the one-curl diagnosis the observatory owes.
             snap = self.pipeline_snapshot()
@@ -933,12 +971,19 @@ class ServeRunner:
         from ..telemetry.pipeline import attribute
 
         busy = dict(self._stage_clock.busy)
+        now = time.monotonic()
         wall = (
-            time.monotonic() - self._loop_start_mono
+            now - self._loop_start_mono
             if self._loop_start_mono is not None
             else 0.0
         )
         attr = attribute(busy, wall, self._rows_published)
+        # The wedged-stage breadcrumb: mid-stall, busy counters lag (a
+        # stage is only credited when it ENDS), so the dominant stage
+        # can misname a live wedge. current_stage is where the loop is
+        # right now and for how long — the incident diagnoser's primary
+        # witness for a stall.
+        cur = self._loop_stage
         return {
             "busy_s": {s: round(t, 4) for s, t in sorted(busy.items())},
             "wall_s": round(wall, 4),
@@ -947,6 +992,11 @@ class ServeRunner:
             },
             "coverage": attr.get("coverage"),
             "dominant_stage": attr["dominant_stage"],
+            "current_stage": (
+                {"stage": cur[0], "for_s": round(now - cur[1], 4)}
+                if cur is not None
+                else None
+            ),
         }
 
     def _statusz(self) -> dict:
@@ -1041,6 +1091,14 @@ class ServeRunner:
             "adaptation": (
                 self._adapt.snapshot() if self._adapt is not None else None
             ),
+            # Incident autopsy plane: bundle count + open alerts; None
+            # when the plane is off (--no-incidents or no run log). The
+            # collector lifts "count" into the fleet history store.
+            "incidents": (
+                self._incidents.statusz_section()
+                if self._incidents is not None
+                else None
+            ),
         }
 
     # -- the loop ------------------------------------------------------------
@@ -1066,6 +1124,10 @@ class ServeRunner:
                         self._ingress.stop()
                     self.batcher.flush()
                 wait_start = time.monotonic()
+                # Wedged-stage breadcrumbs (pipeline_snapshot's
+                # current_stage): each boundary reuses the clock read it
+                # already takes — no extra hot-loop time calls.
+                self._loop_stage = ("seal_wait", wait_start)
                 item = self.batcher.get(0.0 if inflight else params.poll_s)
                 if self._stage_clock is not None:
                     # seal_wait = the loop blocked for input; folding it
@@ -1081,6 +1143,7 @@ class ServeRunner:
                     # done anyway). None when forensics is off.
                     entry = self._capture_entry()
                     feed_start = time.monotonic()
+                    self._loop_stage = ("feed", feed_start)
                     flags = self.det.feed(self.det.place(item.chunk))
                     # Row-tracing stamp: the chunk entered the device
                     # pipeline (queue stage ends, device stage begins).
@@ -1171,8 +1234,10 @@ class ServeRunner:
         import jax
 
         pub_start = time.monotonic()  # loop blocks on the device sync here
+        self._loop_stage = ("device", pub_start)
         host = jax.tree.map(np.asarray, flags)
         collected_mono = time.monotonic()  # device stage ends here
+        self._loop_stage = ("collect", collected_mono)
         cg = np.asarray(host.change_global)
         changed = cg >= 0
         changes = [
@@ -1239,6 +1304,9 @@ class ServeRunner:
             # the sidecar verdict joins back to its originating packets
             record["traces"] = [m["trace_id"] for m in trace_marks]
         assembled_mono = time.monotonic()  # collect stage ends here
+        # Set BEFORE the faults.fire below: a planted serve.flush stall
+        # must read as publish-bound in the incident bundle.
+        self._loop_stage = ("publish", assembled_mono)
         # Per-chunk latency split (admission/queue/device/collect), from
         # the stamps every seal already carries — present in BOTH
         # pipeline-metrics modes, so the sidecar schema never depends on
@@ -1315,6 +1383,7 @@ class ServeRunner:
             )
             self._rows_traced += len(trace_ids)
         hooks_start = time.monotonic()  # publish stage ends here
+        self._loop_stage = ("forensics", hooks_start)
         if self._forensics is not None and chunk is not None:
             entry_host = (
                 jax.tree.map(np.asarray, entry) if entry is not None else None
@@ -1328,6 +1397,7 @@ class ServeRunner:
                 trace_ids=trace_ids,
             )
         forensics_done = time.monotonic()
+        self._loop_stage = ("adapt", forensics_done)
         if self._adapt is not None:
             # the reaction arm: route this verdict through the per-tenant
             # policy — forensics above explains the drift, this acts on it
@@ -1511,6 +1581,15 @@ class ServeRunner:
                 self._recorder.dump(
                     os.path.splitext(self._log.path)[0] + FLIGHTREC_SUFFIX
                 )
+            # Crash incident bundle: the full cross-plane autopsy (the
+            # flight ring above plus pipeline/statusz/verdict-tail
+            # evidence) — the crash-only dump, generalized. Best-effort:
+            # it must never mask the original failure either.
+            if self._incidents is not None:
+                try:
+                    self._incidents.capture_crash(sys.exc_info()[1])
+                except Exception:
+                    pass
             self._log.close()
         self._close_files()
 
@@ -1644,6 +1723,21 @@ def main(argv=None) -> None:
                     help="disable drift evidence bundles "
                     "(<run-log>.forensics/; on by default with a "
                     "telemetry dir)")
+    ap.add_argument("--no-incidents", action="store_true",
+                    help="disable the incident autopsy plane "
+                    "(<run-log>.incidents/ bundles captured when an SLO "
+                    "alert fires or the daemon crashes; on by default "
+                    "with a telemetry dir — verdict sidecars are "
+                    "bit-identical either way)")
+    ap.add_argument("--incident-store", default="",
+                    help="history-store directory (collector --store): "
+                    "bundles also extract the recent fleet time-series "
+                    "window + top-tenant ranking from it")
+    ap.add_argument("--incident-window-s", type=float, default=120.0,
+                    help="history window extracted into each bundle")
+    ap.add_argument("--incident-max", type=int, default=32,
+                    help="bundle cap per run (alert flapping must not "
+                    "fill the disk; skipped captures are counted)")
     ap.add_argument("--on-drift", action="append", default=[],
                     metavar="[T=]POLICY[,k=v...]",
                     help="drift-reaction policy (adapt/ subsystem), "
@@ -1727,6 +1821,10 @@ def main(argv=None) -> None:
         pipeline_metrics=not args.no_pipeline_metrics,
         forensics=not args.no_forensics,
         on_drift=tuple(args.on_drift),
+        incidents=not args.no_incidents,
+        incident_store=args.incident_store,
+        incident_window_s=args.incident_window_s,
+        incident_max=args.incident_max,
     )
     runner = ServeRunner(cfg, params, max_chunks=args.max_chunks)
     banner = runner.start()

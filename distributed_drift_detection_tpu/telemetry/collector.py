@@ -51,6 +51,7 @@ import urllib.error
 import urllib.request
 
 from .history import DEFAULT_SEGMENT_BYTES, HistoryStore, avg_over_time
+from .incident import INCIDENT_OPEN_SERIES, INCIDENTS_TOTAL_SERIES
 from .metrics import MetricsRegistry, parse_prometheus_text
 
 #: Self-metering series (stored with instance="collector").
@@ -215,6 +216,29 @@ def scrape_once(
                     "serve_alerts_active",
                     {"instance": target.name},
                     float(len(alerts)),
+                )
+            )
+        # Incident plane (telemetry.incident): lift /incidentz into the
+        # fleet index series. Its OWN try block — a pre-incident daemon
+        # 404s here (HTTPError ⊂ URLError) and must NOT be down-marked;
+        # the /metrics+/statusz scrape above already proved it alive.
+        try:
+            inc = _get_json(target.base_url + "/incidentz", timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            inc = None
+        if isinstance(inc, dict):
+            samples.append(
+                (
+                    INCIDENTS_TOTAL_SERIES,
+                    {"instance": target.name},
+                    float(inc.get("count") or 0),
+                )
+            )
+            samples.append(
+                (
+                    INCIDENT_OPEN_SERIES,
+                    {"instance": target.name},
+                    float(inc.get("open") or 0),
                 )
             )
     up_count = sum(1 for t in targets if t.up)

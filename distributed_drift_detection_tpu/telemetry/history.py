@@ -532,6 +532,24 @@ def avg_over_time(
     }
 
 
+def last_over_time(
+    root: str,
+    name: str,
+    *,
+    labels: "dict | None" = None,
+    window_s: float = 300.0,
+    at: "float | None" = None,
+) -> "dict[tuple, float | None]":
+    """The newest sample value per matching series over the window —
+    the fleet-index primitive for monotone per-instance gauges/counters
+    (``serve_incidents_total{instance=...}``: the latest scrape IS the
+    current count; averaging or summing a cumulative count would lie)."""
+    return {
+        key: (vs[-1] if vs else None)
+        for key, vs in _window_values(root, name, labels, window_s, at).items()
+    }
+
+
 def top_tenants(
     root: str,
     *,

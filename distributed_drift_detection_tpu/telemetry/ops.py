@@ -25,6 +25,10 @@ ingress):
                sweep scheduler): the merged fleet view — summed rows/s,
                max per-stage busy share, per-backend bottleneck. A
                plain daemon 404s here.
+``/incidentz`` incident-plane daemons only (``incidentz_fn``): bundle
+               count, open-alert count, and the latest incident
+               manifest. A pre-incident daemon 404s here; the collector
+               treats that as "no incident plane", never as down.
 =============  ==========================================================
 
 Handlers never *write* daemon state: the server is constructed with
@@ -100,15 +104,33 @@ class FlightRecorder:
 
     def dump(self, path: str) -> "str | None":
         """Write the ring to ``path`` (one event per line, verbatim);
-        returns the path, or ``None`` when the ring is empty (no file —
-        an empty dump would read as evidence). Best-effort by contract:
-        called from crash paths, it must not mask the original error."""
+        returns the path actually written, or ``None`` when the ring is
+        empty (no file — an empty dump would read as evidence).
+
+        Collision-safe for multi-dump runs: if ``path`` already exists
+        (an earlier dump in the same process lifetime — the incident
+        plane may dump the ring many times before a crash does), the
+        write lands at ``<stem>-2{suffix}``, ``-3``, ... instead of
+        overwriting evidence. The compound ``.flightrec.jsonl`` suffix is
+        kept intact so the registry's sidecar skip still recognizes the
+        renamed dump, and a first dump keeps the bare name — the crash
+        path's "absence = clean exit" CI signal is untouched.
+        Best-effort by contract: called from crash paths, it must not
+        mask the original error."""
         with self._lock:
             events = list(self._buf)
         if not events:
             return None
+        if path.endswith(FLIGHTREC_SUFFIX):
+            base, ext = path[: -len(FLIGHTREC_SUFFIX)], FLIGHTREC_SUFFIX
+        else:
+            base, ext = os.path.splitext(path)
+        k = 1
+        while os.path.exists(path):
+            k += 1
+            path = f"{base}-{k}{ext}"
         try:
-            with open(path, "w") as fh:
+            with open(path, "x") as fh:
                 for e in events:
                     fh.write(json.dumps(e) + "\n")
                 fh.flush()
@@ -147,6 +169,14 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 # view; a plain daemon keeps 404-ing here
                 body = (
                     json.dumps(self.server.fleetz_fn(), indent=1) + "\n"
+                ).encode()
+                code, ctype = 200, "application/json"
+            elif path == "/incidentz" and self.server.incidentz_fn is not None:
+                # incident-plane daemons only: bundle count + latest
+                # manifest; a pre-incident daemon keeps 404-ing here (the
+                # collector treats that as "no incident plane", not down)
+                body = (
+                    json.dumps(self.server.incidentz_fn(), indent=1) + "\n"
                 ).encode()
                 code, ctype = 200, "application/json"
             else:
@@ -189,6 +219,7 @@ class OpsServer(ThreadingHTTPServer):
         health_fn,
         status_fn,
         fleetz_fn=None,
+        incidentz_fn=None,
     ):
         super().__init__((host, port), _OpsHandler)
         self._metrics_fn = metrics_fn
@@ -198,6 +229,9 @@ class OpsServer(ThreadingHTTPServer):
         # (the tenant router, the sweep scheduler); None = 404, so a
         # plain daemon's ops surface is unchanged.
         self.fleetz_fn = fleetz_fn
+        # Optional incident index (``/incidentz``): set by daemons with
+        # an IncidentRecorder; None = 404 (pre-incident daemons).
+        self.incidentz_fn = incidentz_fn
         self._thread: "threading.Thread | None" = None
 
     @property

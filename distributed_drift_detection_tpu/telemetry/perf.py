@@ -181,6 +181,13 @@ CELLS = (
     # stream geometry and the chunk span; the adapt-smoke CI job and
     # tests/test_adapt.py own correctness.
     ("serve_adapt_recovery_rows", _DOWN, False, "rows"),
+    # Incident autopsy capture span (bench.py --smoke rider, r18+): median
+    # wall-clock of one IncidentRecorder.capture() over realistic evidence
+    # sources (full flight ring, snapshots, verdict tail). Informational —
+    # the capture runs on the SLO evaluator thread, off the serve hot loop
+    # (the sidecar bit-parity test owns that claim); this cell keeps the
+    # off-loop cost visible round over round.
+    ("serve_incident_capture_ms", _DOWN, False, "ms"),
     # History plane micro-bench (bench.py --history, r17+): append and
     # query throughput of the jax-free on-disk series store. Informational
     # — both move with the filesystem under the runner; the history-smoke
@@ -473,6 +480,7 @@ def bench_cells(bench: dict) -> tuple[dict[str, float], list[str]]:
         "sched_serial_cells_per_sec",
         "sched_speedup",
         "serve_adapt_recovery_rows",
+        "serve_incident_capture_ms",
         "history_append_samples_per_sec",
         "history_rate_query_ms",
         "mean_delay_batches",

@@ -414,6 +414,9 @@ def backend_snapshot(
         # live SLO alert count from the backend's own engine — summed
         # into the fleet row so `top` can show fleet-wide alert state
         "alerts": len(statusz.get("alerts") or []),
+        # captured incident bundles (statusz `incidents` section; 0 for
+        # pre-incident daemons) — the fleet incident index's per-backend cell
+        "incidents": int((statusz.get("incidents") or {}).get("count") or 0),
         "bottleneck": attr.get("dominant_stage"),
         "busy_share": {
             s: c["share"] for s, c in attr.get("stages", {}).items()
@@ -444,6 +447,7 @@ def aggregate_fleet(backends: list[dict]) -> dict:
                 sum(float(b.get("rows_per_sec") or 0.0) for b in alive), 3
             ),
             "alerts": sum(int(b.get("alerts") or 0) for b in alive),
+            "incidents": sum(int(b.get("incidents") or 0) for b in alive),
             "stage_busy_share_max": {
                 s: share_max[s] for s in sorted(share_max)
             },
@@ -469,6 +473,10 @@ def fleet_metrics_lines(fleetz: dict) -> list[str]:
         "# HELP fleet_backends_alive Alive backends in the scraped fleet",
         "# TYPE fleet_backends_alive gauge",
         f"fleet_backends_alive {fleet.get('alive', 0)}",
+        "# HELP fleet_incidents Captured incident bundles summed across "
+        "alive backends",
+        "# TYPE fleet_incidents gauge",
+        f"fleet_incidents {fleet.get('incidents', 0)}",
     ]
     shares = fleet.get("stage_busy_share_max") or {}
     if shares:

@@ -204,6 +204,13 @@ class SloEngine:
         self._lock = threading.Lock()
         self._window_avg_fn = window_avg_fn
         self._now_fn = now_fn
+        #: Optional transition observer, called once per *successfully
+        #: emitted* transition dict, on the evaluator thread — the
+        #: incident recorder's capture hook. Rolled-back transitions are
+        #: never observed (they re-fire next tick), so an observer sees
+        #: exactly the transitions the log recorded. Failures are
+        #: swallowed: evidence capture must never kill the judge.
+        self.observer = None
         # rule name -> ring of (mono_ts, value) bounded by the slow window
         self._history: dict[str, deque] = {}
         self._gauge = None
@@ -263,6 +270,11 @@ class SloEngine:
                         "state": "firing",
                         "value": value,
                         "threshold": rule.threshold,
+                        # monotonic stamp as a schema-legal extra, so
+                        # incident/history timelines rebase alert
+                        # transitions across restarts exactly like
+                        # heartbeats (events.py carries extras verbatim)
+                        "mono": self._now_fn(),
                     }
                     self._firing[name] = rec
                     transitions.append(rec)
@@ -277,6 +289,7 @@ class SloEngine:
                             "state": "resolved",
                             "value": value,
                             "threshold": rule.threshold,
+                            "mono": self._now_fn(),
                         }
                     )
         emitted = transitions
@@ -301,6 +314,12 @@ class SloEngine:
                     emitted = transitions[:i]
                     break
         self._sync_gauges()
+        if self.observer is not None:
+            for t in emitted:
+                try:
+                    self.observer(dict(t))
+                except Exception:
+                    pass  # capture failure must never kill the evaluator
         return emitted
 
     def _sync_gauges(self) -> None:

@@ -189,6 +189,9 @@ class StatuszSource:
             "p99_ms": lat.get("p99"),
             "detections": s.get("detections"),
             "quarantined": (s.get("rows") or {}).get("quarantined"),
+            # incident autopsy bundles captured this run (the /statusz
+            # incidents section; "-" on pre-incident daemons)
+            "incidents": (s.get("incidents") or {}).get("count"),
             "wire": wire,
             "busy": busy,
             "alerts": sorted(a["rule"] for a in s.get("alerts") or []),
@@ -216,6 +219,7 @@ class StatuszSource:
                     "status": "live" if b.get("alive") else "down",
                     "rows": b.get("rows"),
                     "rows_per_sec": b.get("rows_per_sec"),
+                    "incidents": b.get("incidents"),
                     "busy": (
                         _share_cell(dom, share.get(dom)) if dom else None
                     ),
@@ -244,6 +248,7 @@ class StatuszSource:
                 "status": "fleet",
                 "rows": fleet.get("rows"),
                 "rows_per_sec": fleet.get("rows_per_sec"),
+                "incidents": fleet.get("incidents"),
                 "busy": busy,
                 "alerts": [f"{n_alerts} firing"] if n_alerts else [],
             }
@@ -317,6 +322,7 @@ _COLUMNS = (
     ("P99ms", "p99_ms", 10),
     ("DET", "detections", 7),
     ("QUAR", "quarantined", 7),
+    ("INC", "incidents", 5),
     ("WIRE", "wire", 16),
     ("BUSY", "busy", 14),
     ("TREND", "trend", 14),
@@ -333,6 +339,7 @@ _RECORD_COLS = (
     "p99_ms",
     "detections",
     "quarantined",
+    "incidents",
     "age_s",
 )
 
@@ -397,7 +404,7 @@ def replay_frames(store_dir: str) -> "list[tuple[float, list[dict]]]":
             key = name[len("top_"):]
             v = rec["value"]
             row[key] = int(v) if key in ("rows", "detections",
-                                         "quarantined") else v
+                                         "quarantined", "incidents") else v
     return [
         (ts, list(by_inst.values())) for ts, by_inst in sorted(frames.items())
     ]

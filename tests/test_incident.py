@@ -1,0 +1,723 @@
+"""Incident autopsy plane (telemetry.incident): alert-triggered capture,
+bundle atomicity, the deterministic diagnosis engine, the fleet index,
+and the live-daemon integration.
+
+Contracts pinned here:
+
+* a ``firing`` transition captures a numbered, self-contained bundle
+  whose ``manifest.json`` lands LAST (its presence == bundle complete);
+  a daemon killed mid-capture leaves a manifest-less directory every
+  reader surfaces as a loud ``partial: true``, never a crash;
+* :func:`~telemetry.incident.diagnose` is deterministic and bundle-only,
+  and names the *planted* cause — a ``serve.flush`` stall under live
+  load diagnoses ``publish-bound`` from the wedged-stage breadcrumb,
+  citing the numbers;
+* verdict sidecars are bit-identical with incidents on and off;
+* the collector lifts ``/incidentz`` into the history store without
+  down-marking pre-incident daemons (404 there is "no incident plane");
+* alert events carry a ``mono`` extra; the flight-recorder dump is
+  collision-safe for multi-dump runs; ``/healthz`` names the bottleneck
+  stage for ``burn_rate`` firings too.
+"""
+
+import http.server
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_drift_detection_tpu.config import RunConfig, ServeParams
+from distributed_drift_detection_tpu.resilience import faults
+from distributed_drift_detection_tpu.telemetry import history, incident
+from distributed_drift_detection_tpu.telemetry import registry
+from distributed_drift_detection_tpu.telemetry.history import HistoryStore
+from distributed_drift_detection_tpu.telemetry.incident import (
+    BUNDLE_PREFIX,
+    INCIDENT_OPEN_SERIES,
+    INCIDENTS_SUFFIX,
+    INCIDENTS_TOTAL_SERIES,
+    MANIFEST_NAME,
+    IncidentRecorder,
+    diagnose,
+    list_bundles,
+    read_bundle,
+    render_bundle,
+    render_diagnosis,
+    resolve_incidents_dir,
+)
+from distributed_drift_detection_tpu.telemetry.metrics import MetricsRegistry
+from distributed_drift_detection_tpu.telemetry.ops import (
+    FLIGHTREC_SUFFIX,
+    FlightRecorder,
+    OpsServer,
+)
+from distributed_drift_detection_tpu.telemetry.slo import SloEngine, parse_rules
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# --- unit: capture, bundle atomicity, partial bundles ----------------------
+
+
+class _FakeFlight:
+    def __init__(self, events=()):
+        self.events = list(events)
+
+    def dump(self, path):
+        if not self.events:
+            return None
+        with open(path, "w") as fh:
+            for e in self.events:
+                fh.write(json.dumps(e) + "\n")
+        return path
+
+
+def _recorder(tmp_path, **kw):
+    stem = str(tmp_path / "r-test")
+    with open(stem + ".verdicts.jsonl", "w") as fh:
+        for i in range(10):
+            fh.write(json.dumps({"kind": "verdict", "chunk": i}) + "\n")
+    kw.setdefault("flight", _FakeFlight([{"type": "heartbeat"}]))
+    kw.setdefault(
+        "statusz_fn",
+        lambda: {"rows": {"ingress_seen": 100, "quarantined": 1}},
+    )
+    kw.setdefault(
+        "pipeline_fn",
+        lambda: {
+            "busy_s": {"publish": 3.0, "device": 0.2},
+            "wall_s": 4.0,
+            "shares": {"publish": 0.9, "device": 0.06},
+            "dominant_stage": "publish",
+            "current_stage": {"stage": "publish", "for_s": 1.7},
+        },
+    )
+    kw.setdefault("verdicts_path", stem + ".verdicts.jsonl")
+    return IncidentRecorder(stem, **kw)
+
+
+def test_firing_captures_bundle_resolve_closes_it(tmp_path):
+    m = MetricsRegistry()
+    rec = _recorder(tmp_path, metrics=m, max_bundles=2)
+    rec.on_transition(
+        {"rule": "stall_s", "state": "firing", "value": 1.9,
+         "threshold": 0.4, "mono": 12.5}
+    )
+    assert rec.statusz_section() == {
+        "count": 1, "open": 1, "skipped": 0, "dir": rec.root,
+    }
+    (bundle,) = list_bundles(rec.root)
+    b = read_bundle(bundle)
+    assert not b["partial"]
+    man = b["manifest"]
+    assert man["rule"] == "stall_s" and man["value"] == 1.9
+    assert man["threshold"] == 0.4 and man["alert_mono"] == 12.5
+    assert man["kind"] == "alert" and man["capture_ms"] >= 0
+    # every evidence plane landed and is listed in the manifest
+    assert set(man["files"]) == {
+        "flightrec.jsonl", "pipeline.json", "statusz.json",
+        "verdicts_tail.jsonl",
+    }
+    assert b["resolved"] is None  # still open
+    assert len(b["verdicts_tail"]) == 10
+
+    rec.on_transition(
+        {"rule": "stall_s", "state": "resolved", "value": 0.1,
+         "threshold": 0.4, "mono": 14.0}
+    )
+    b = read_bundle(bundle)
+    assert b["resolved"]["state"] == "resolved"
+    assert rec.statusz_section()["open"] == 0
+
+    # bundle cap: captures beyond max are counted, not written
+    rec.on_transition({"rule": "p99_ms", "state": "firing", "value": 9.0,
+                       "threshold": 5.0})
+    rec.on_transition({"rule": "verdict_age_s", "state": "firing",
+                       "value": 9.0, "threshold": 5.0})
+    assert len(list_bundles(rec.root)) == 2
+    iz = rec.incidentz()
+    assert iz["count"] == 2 and iz["skipped"] == 1
+    assert iz["latest"]["rule"] == "p99_ms"
+    # metrics: per-rule capture counter + the open gauge
+    text = m.to_prometheus_text()
+    assert 'incident_captures_total{rule="stall_s"} 1' in text
+    assert 'incident_captures_total{rule="p99_ms"} 1' in text
+    assert "incident_open 1" in text  # p99_ms still open
+
+
+def test_killed_mid_capture_reads_as_loud_partial(tmp_path, capsys):
+    rec = _recorder(tmp_path)
+    rec.on_transition({"rule": "stall_s", "state": "firing", "value": 2.0,
+                       "threshold": 0.4})
+    (bundle,) = list_bundles(rec.root)
+    # simulate the daemon dying before the manifest landed
+    os.remove(os.path.join(bundle, MANIFEST_NAME))
+    # ...and a torn evidence file from the same death
+    with open(os.path.join(bundle, "flightrec.jsonl"), "a") as fh:
+        fh.write('{"type": "torn')
+
+    b = read_bundle(bundle)
+    assert b["partial"] is True and b["manifest"] is None
+    assert b["pipeline"]["dominant_stage"] == "publish"  # what landed reads
+    # every CLI path reads it loudly, none crashes
+    assert "PARTIAL: true" in render_bundle(b)
+    assert "PARTIAL: true" in render_diagnosis(b, diagnose(b))
+    assert incident.main(["list", rec.root]) == 0
+    assert "PARTIAL" in capsys.readouterr().out
+    assert incident.main(["show", bundle]) == 0
+    assert "PARTIAL" in capsys.readouterr().out
+    assert incident.main(["diagnose", bundle, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["partial"] is True and out["causes"]
+
+
+def test_cli_exit_codes_and_source_resolution(tmp_path, capsys):
+    assert incident.main(["list", str(tmp_path / "nope")]) == 4
+    assert "no incidents" in capsys.readouterr().err
+    root = tmp_path / ("r" + INCIDENTS_SUFFIX)
+    root.mkdir()
+    assert incident.main(["list", str(root)]) == 3  # empty root
+    assert incident.main(["diagnose", str(root)]) == 3
+    rec = _recorder(tmp_path)
+    rec.on_transition({"rule": "p99_ms", "state": "firing", "value": 9.0,
+                       "threshold": 5.0})
+    # run log -> stem sibling; telemetry dir -> newest .incidents inside
+    assert resolve_incidents_dir(rec.stem + ".jsonl") == rec.root
+    assert resolve_incidents_dir(str(tmp_path)) == rec.root
+    assert incident.main(["diagnose", rec.stem + ".jsonl"]) == 0
+    capsys.readouterr()
+    assert incident.main(["list", str(tmp_path), "--json"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert [b["id"] for b in listed["bundles"]] == [BUNDLE_PREFIX + "0001"]
+
+
+# --- unit: the diagnosis rules ---------------------------------------------
+
+
+def test_diagnose_names_wedged_stage_over_stale_shares():
+    """Mid-stall the busy counters lag (a stage is credited when it
+    ENDS): the breadcrumb must out-rank the stale dominant share."""
+    b = {
+        "manifest": {"rule": "stall_s", "value": 1.9, "threshold": 0.4},
+        "pipeline": {
+            "busy_s": {"device": 5.0, "publish": 0.1},
+            "wall_s": 6.0,
+            "shares": {"device": 0.95, "publish": 0.02},
+            "dominant_stage": "device",  # stale: publish not credited yet
+            "current_stage": {"stage": "publish", "for_s": 1.7},
+        },
+    }
+    causes = diagnose(b)
+    assert causes[0]["cause"] == "publish-bound"
+    assert causes[0]["score"] == 0.95
+    assert "1.7" in causes[0]["evidence"]  # cites the wedge duration
+    assert "0.4" in causes[0]["evidence"]  # ...and the threshold
+    # determinism: same bundle, same ranking
+    assert diagnose(b) == causes
+
+
+def test_diagnose_under_driven_and_dominant_share():
+    b = {
+        "manifest": {"rule": "p99_ms", "value": 9.0, "threshold": 5.0},
+        "pipeline": {
+            "busy_s": {"seal_wait": 8.0, "device": 1.0},
+            "wall_s": 10.0,
+            "shares": {"seal_wait": 0.8, "device": 0.1},
+            "dominant_stage": "seal_wait",
+        },
+    }
+    causes = diagnose(b)
+    assert causes[0]["cause"] == "under-driven"
+    assert "80.0%" in causes[0]["evidence"]
+
+    b["pipeline"] = {
+        "busy_s": {"device": 6.0, "seal_wait": 1.0},
+        "wall_s": 8.0,
+        "shares": {"device": 0.75, "seal_wait": 0.12},
+        "dominant_stage": "device",
+    }
+    causes = diagnose(b)
+    assert causes[0]["cause"] == "device-bound"
+    assert "75.0%" in causes[0]["evidence"]
+
+
+def test_diagnose_hot_tenant_quarantine_adaptation_backend_down():
+    b = {
+        "manifest": {"rule": "quarantine_pct", "value": 12.0,
+                     "threshold": 5.0},
+        "statusz": {"rows": {"ingress_seen": 1000, "quarantined": 120}},
+        "top_tenants": [
+            {"tenant": 7, "rows_per_sec": 900.0},
+            {"tenant": 1, "rows_per_sec": 50.0},
+            {"tenant": 2, "rows_per_sec": 40.0},
+            {"tenant": 3, "rows_per_sec": 60.0},
+        ],
+        "flightrec": [{"type": "adaptation"}] * 4 + [{"type": "heartbeat"}],
+        "history": [
+            {"name": "up", "labels": {"instance": "be-2"}, "value": 0.0},
+            {"name": "up", "labels": {"instance": "be-1"}, "value": 1.0},
+        ],
+    }
+    by_cause = {c["cause"]: c for c in diagnose(b)}
+    assert by_cause["quarantine-spike"]["score"] == 0.9
+    assert "120 of 1000" in by_cause["quarantine-spike"]["evidence"]
+    assert "tenant 7" in by_cause["hot-tenant-skew"]["evidence"]
+    assert "900" in by_cause["hot-tenant-skew"]["evidence"]
+    assert "4 adaptation events" in by_cause["adaptation-storm"]["evidence"]
+    assert by_cause["backend-down"]["score"] == 0.9
+    assert "be-2" in by_cause["backend-down"]["evidence"]
+    assert "be-1" not in by_cause["backend-down"]["evidence"]
+
+
+def test_diagnose_empty_bundle_falls_back_to_the_rule():
+    (verdict,) = diagnose({"manifest": {"rule": "p99_ms", "value": 9.0,
+                                        "threshold": 5.0}})
+    assert verdict["cause"] == "p99_ms" and verdict["score"] == 0.1
+    (verdict,) = diagnose({"partial": True})
+    assert verdict["cause"] == "unknown"
+
+
+# --- unit: SLO observer hook + mono extras ---------------------------------
+
+
+def test_slo_transitions_carry_mono_and_feed_observer(tmp_path):
+    from distributed_drift_detection_tpu.telemetry.events import (
+        EventLog, read_events,
+    )
+
+    engine = SloEngine(parse_rules(["stall_s=5"]), now_fn=lambda: 42.0)
+    seen = []
+    engine.observer = lambda t: seen.append(t)
+    log = EventLog.open_run(str(tmp_path), name="slo")
+    engine.evaluate({"stall_s": 9.0}, log.emit)
+    engine.evaluate({"stall_s": 1.0}, log.emit)
+    log.close()
+    alerts = [e for e in read_events(log.path) if e["type"] == "alert"]
+    # the schema-legal mono extra rides every alert event at emit time
+    assert [(a["state"], a["mono"]) for a in alerts] == [
+        ("firing", 42.0), ("resolved", 42.0),
+    ]
+    # observer saw exactly the emitted transitions, in order
+    assert [(t["rule"], t["state"]) for t in seen] == [
+        ("stall_s", "firing"), ("stall_s", "resolved"),
+    ]
+
+
+def test_slo_observer_never_sees_rolled_back_and_never_kills():
+    engine = SloEngine(parse_rules(["stall_s=5"]))
+    seen = []
+    engine.observer = lambda t: seen.append(t)
+
+    def refuse(etype, **fields):
+        raise OSError("disk full")
+
+    engine.evaluate({"stall_s": 9.0}, refuse)  # rolled back -> not observed
+    assert seen == []
+
+    def boom(t):
+        raise RuntimeError("capture exploded")
+
+    engine.observer = boom
+    t = engine.evaluate({"stall_s": 9.0}, None)  # observer failure swallowed
+    assert [x["state"] for x in t] == ["firing"]
+    assert [a["rule"] for a in engine.active()] == ["stall_s"]
+
+
+# --- unit: flight-recorder multi-dump collision safety ---------------------
+
+
+def test_flightrec_dump_collision_safe_keeps_sidecar_suffix(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec.record({"type": "heartbeat", "i": 1})
+    path = str(tmp_path / ("r" + FLIGHTREC_SUFFIX))
+    assert rec.dump(path) == path  # first dump: the bare crash-path name
+    second = rec.dump(path)
+    third = rec.dump(path)
+    # later dumps uniquify WITHOUT breaking the compound suffix, so the
+    # registry's sidecar skip still recognizes them
+    assert second == str(tmp_path / ("r-2" + FLIGHTREC_SUFFIX))
+    assert third == str(tmp_path / ("r-3" + FLIGHTREC_SUFFIX))
+    for p in (path, second, third):
+        assert p.endswith(FLIGHTREC_SUFFIX)
+        assert json.loads(open(p).read())["i"] == 1
+
+
+def test_renamed_dumps_stay_invisible_to_run_log_discovery(tmp_path):
+    from distributed_drift_detection_tpu.telemetry.events import EventLog
+
+    log = EventLog.open_run(str(tmp_path), name="x")
+    log.emit("run_started", run_id=log.run_id, config={})
+    log.close()
+    rec = FlightRecorder(capacity=4)
+    rec.record({"type": "heartbeat"})
+    stem = os.path.splitext(log.path)[0]
+    rec.dump(stem + FLIGHTREC_SUFFIX)
+    rec.dump(stem + FLIGHTREC_SUFFIX)  # the renamed -2 dump
+    assert registry.newest_run_log(str(tmp_path)) == log.path
+
+
+# --- unit: /incidentz endpoint + /healthz burn-rate bottleneck -------------
+
+
+def test_incidentz_endpoint_404_without_plane_200_with(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.on_transition({"rule": "stall_s", "state": "firing", "value": 2.0,
+                       "threshold": 0.4})
+    plain = OpsServer(
+        "127.0.0.1", 0,
+        metrics_fn=lambda: "", health_fn=lambda: (200, {}),
+        status_fn=lambda: {},
+    )
+    plain.start()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"http://127.0.0.1:{plain.port}/incidentz")
+    assert ei.value.code == 404
+    plain.stop()
+
+    srv = OpsServer(
+        "127.0.0.1", 0,
+        metrics_fn=lambda: "", health_fn=lambda: (200, {}),
+        status_fn=lambda: {}, incidentz_fn=rec.incidentz,
+    )
+    srv.start()
+    code, body = _get(f"http://127.0.0.1:{srv.port}/incidentz")
+    srv.stop()
+    iz = json.loads(body)
+    assert code == 200 and iz["count"] == 1
+    assert iz["latest"]["rule"] == "stall_s"
+
+
+def test_healthz_names_bottleneck_for_burn_rate_firings(tmp_path):
+    from distributed_drift_detection_tpu.serve.runner import ServeRunner
+    from distributed_drift_detection_tpu.telemetry.pipeline import (
+        ServeStageClock,
+    )
+
+    runner = ServeRunner(
+        RunConfig(partitions=2, per_batch=25, results_csv=""),
+        ServeParams(num_features=3, num_classes=2, port=None),
+    )
+    clock = ServeStageClock()
+    clock.add("device", 6.0)
+    clock.add("publish", 0.5)
+    runner._stage_clock = clock
+    runner._loop_start_mono = time.monotonic() - 10.0
+
+    class _SLO:
+        def active(self):
+            return [{"rule": "burn_rate:p99_ms", "value": 2.0}]
+
+    runner._slo = _SLO()
+    code, payload = runner._health()
+    assert code == 503
+    assert payload["bottleneck_stage"] == "device"
+
+
+# --- unit: collector lifts /incidentz; 404 never down-marks ----------------
+
+
+class _FakeDaemon(http.server.BaseHTTPRequestHandler):
+    incidentz = None  # class attr: None = pre-incident daemon (404)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        if self.path == "/metrics":
+            body, ctype = b"# EOF\n", "text/plain"
+        elif self.path == "/statusz":
+            body = json.dumps({"rows_per_sec": 10.0, "alerts": []}).encode()
+            ctype = "application/json"
+        elif self.path == "/incidentz" and self.incidentz is not None:
+            body = json.dumps(self.incidentz).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve_fake(handler):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_collector_scrapes_incidentz_into_fleet_index(tmp_path, capsys):
+    from distributed_drift_detection_tpu.telemetry.collector import (
+        Target, scrape_once,
+    )
+
+    class _With(_FakeDaemon):
+        incidentz = {"count": 3, "open": 1, "skipped": 0}
+
+    with_srv, without_srv = _serve_fake(_With), _serve_fake(_FakeDaemon)
+    try:
+        targets = [
+            Target("inc", f"http://127.0.0.1:{with_srv.server_address[1]}"),
+            Target("pre", f"http://127.0.0.1:{without_srv.server_address[1]}"),
+        ]
+        root = str(tmp_path / "store")
+        with HistoryStore(root) as store:
+            summary = scrape_once(store, targets, timeout=5.0)
+        # one cycle: the incident series land for the incident daemon...
+        totals = {
+            r["labels"]["instance"]: r["value"]
+            for r in history.read_samples(root, name=INCIDENTS_TOTAL_SERIES)
+        }
+        assert totals == {"inc": 3.0}
+        opens = history.read_samples(root, name=INCIDENT_OPEN_SERIES)
+        assert [r["value"] for r in opens] == [1.0]
+        # ...and the pre-incident daemon's 404 did NOT down-mark it
+        assert summary["up"] == 2 and summary["errors"] == 0
+        up = {
+            r["labels"]["instance"]: r["value"]
+            for r in history.read_samples(root, name="up")
+        }
+        assert up == {"inc": 1.0, "pre": 1.0}
+        # the fleet incident index the CLI renders from the same store
+        assert incident.main(
+            ["list", str(tmp_path), "--store", root]
+        ) == 4  # no bundles here, but the store query itself must not crash
+    finally:
+        with_srv.shutdown()
+        without_srv.shutdown()
+
+
+# --- unit: top INC column + fleet rows -------------------------------------
+
+
+def test_top_renders_inc_column():
+    from distributed_drift_detection_tpu.telemetry import top as top_mod
+
+    assert ("INC", "incidents", 5) in top_mod._COLUMNS
+    frame = top_mod.render(
+        [{"run": "r1", "status": "live", "rows": 10, "incidents": 2,
+          "alerts": []}],
+        0.0,
+    )
+    header, row = frame.splitlines()[1], frame.splitlines()[2]
+    assert "INC" in header
+    assert header.index("INC") == row.index("2")
+    # record/replay round-trips the column
+    assert "incidents" in top_mod._RECORD_COLS
+
+
+# --- live daemon (jax): planted stall -> bundle -> named cause -------------
+
+
+def _live_cfg(tmp_path, **kw):
+    return RunConfig(
+        partitions=2,
+        per_batch=25,
+        model="centroid",
+        window=1,
+        data_policy="quarantine",
+        results_csv="",
+        telemetry_dir=str(tmp_path / "tele"),
+        **kw,
+    )
+
+
+def _stream(rows_per_class=100):
+    from distributed_drift_detection_tpu.io.synth import rialto_like_xy
+
+    return rialto_like_xy(seed=0, rows_per_class=rows_per_class)
+
+
+def test_planted_stall_captures_bundle_diagnosed_publish_bound(
+    tmp_path, monkeypatch
+):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    faults.arm("serve.flush", kind="stall", at=1, seconds=1.5)
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    params = ServeParams(
+        num_features=X.shape[1],
+        num_classes=10,
+        port=None,
+        ops_port=0,
+        chunk_batches=2,
+        linger_s=0.05,
+        heartbeat_s=0.1,
+        slo=("stall_s=0.4",),
+        slo_interval_s=0.05,
+    )
+    runner = ServeRunner(cfg, params)
+    banner = runner.start()
+    thread = threading.Thread(target=runner.serve_forever, daemon=True)
+    thread.start()
+    runner.admission.admit_lines(format_lines(X[:100], y[:100]))
+    runner.batcher.flush()
+    base = f"http://127.0.0.1:{banner['ops_port']}"
+    captured = None
+    for _ in range(120):  # the stall fires stall_s -> a bundle captures
+        try:
+            code, body = _get(base + "/incidentz", timeout=2)
+            iz = json.loads(body)
+            if iz["count"] >= 1:
+                captured = iz
+                break
+        except urllib.error.HTTPError:
+            pass
+        time.sleep(0.05)
+    assert captured is not None, "no incident captured during the stall"
+    assert captured["latest"]["rule"] == "stall_s"
+    time.sleep(1.6)  # the stall ends; publish resumes, the alert resolves
+    runner.request_stop()
+    thread.join(60)
+    assert not thread.is_alive()
+
+    # /statusz carried the incidents section while live; post-drain the
+    # bundle is on disk next to the run log
+    root = resolve_incidents_dir(cfg.telemetry_dir)
+    assert root is not None and root.endswith(INCIDENTS_SUFFIX)
+    (bundle,) = list_bundles(root)
+    b = read_bundle(bundle)
+    assert not b["partial"]
+    man = b["manifest"]
+    assert man["rule"] == "stall_s" and man["value"] > man["threshold"]
+    assert "flightrec.jsonl" in man["files"]
+    assert "pipeline.json" in man["files"]
+    assert "statusz.json" in man["files"]
+    # the resolve transition closed the incident on disk
+    assert b["resolved"] and b["resolved"]["state"] == "resolved"
+    # the wedged-stage breadcrumb caught the loop INSIDE the planted
+    # publish-stage stall...
+    cur = (b["pipeline"] or {}).get("current_stage") or {}
+    assert cur.get("stage") == "publish", b["pipeline"]
+    assert cur["for_s"] >= 0.3
+    # ...so the diagnosis names the planted cause, citing the numbers
+    causes = diagnose(b)
+    assert causes[0]["cause"] == "publish-bound"
+    assert causes[0]["score"] >= 0.9
+    assert "publish" in causes[0]["evidence"]
+    assert str(man["threshold"]) in causes[0]["evidence"]
+    # the CLI agrees end to end from the telemetry dir alone
+    assert incident.main(["diagnose", cfg.telemetry_dir]) == 0
+    # clean drain: completed registry, NO crash flight-recorder dump
+    runs = registry.runs(cfg.telemetry_dir)
+    assert all(r["status"] == "completed" for r in runs.values())
+    assert not list((tmp_path / "tele").glob("*" + FLIGHTREC_SUFFIX))
+
+
+def test_crash_leaves_incident_bundle_too(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    faults.arm("serve.flush", kind="raise", at=1)
+    X, y = _stream(40)
+    cfg = _live_cfg(tmp_path)
+    runner = ServeRunner(
+        cfg,
+        ServeParams(
+            num_features=X.shape[1], num_classes=10, port=None,
+            chunk_batches=2, linger_s=0.05,
+        ),
+    )
+    runner.start()
+    runner.admission.admit_lines(format_lines(X[:100], y[:100]))
+    runner.batcher.flush()
+    runner.request_stop()
+    with pytest.raises(faults.InjectedFault):
+        runner.serve_forever()
+    # the crash-only dump generalized: a full bundle, rule "crash"
+    root = resolve_incidents_dir(cfg.telemetry_dir)
+    assert root is not None
+    (bundle,) = list_bundles(root)
+    man = read_bundle(bundle)["manifest"]
+    assert man["rule"] == "crash" and man["kind"] == "crash"
+    assert "serve.flush" in man["error"]
+    # the bare crash flightrec dump contract is untouched
+    (dump,) = list((tmp_path / "tele").glob("*" + FLIGHTREC_SUFFIX))
+    assert str(dump).endswith(FLIGHTREC_SUFFIX)
+
+
+# --- live daemon (jax): verdict sidecars bit-identical on/off --------------
+
+
+def _canon(path):
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            rec.pop("ts", None)
+            rec.pop("lat_ms", None)
+            out.append(rec)
+    return out
+
+
+def test_sidecar_bit_parity_incidents_on_off(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from distributed_drift_detection_tpu.io.synth import planted_prototypes
+    from distributed_drift_detection_tpu.serve import ServeRunner
+    from distributed_drift_detection_tpu.serve.loadgen import format_lines
+
+    def _run(name, **kw):
+        stream = planted_prototypes(3, concepts=2, rows_per_concept=400,
+                                    features=5)
+        cfg = RunConfig(
+            partitions=4, per_batch=50, model="centroid", window=1,
+            shuffle_batches=True, seed=3, data_policy="quarantine",
+            results_csv="", telemetry_dir=str(tmp_path / name),
+        )
+        params = ServeParams(
+            num_features=stream.num_features,
+            num_classes=stream.num_classes,
+            port=None, chunk_batches=2, linger_s=0.05,
+            # a hair-trigger alert so the ON run actually captures
+            slo=("p99_ms=0.0001",), slo_interval_s=0.05,
+            **kw,
+        )
+        runner = ServeRunner(cfg, params)
+        banner = runner.start()
+        thread = threading.Thread(target=runner.serve_forever, daemon=True)
+        thread.start()
+        lines = format_lines(stream.X, stream.y)
+        for i in range(0, len(lines), 150):
+            runner.admission.admit_lines(lines[i : i + 150])
+        runner.batcher.flush()
+        # let the evaluator tick over the published verdicts so the
+        # hair-trigger rule actually fires in the ON run (identical
+        # wall-clock shape in the OFF run keeps the comparison honest)
+        for _ in range(100):
+            if runner._rows_published >= len(lines):
+                break
+            time.sleep(0.05)
+        time.sleep(0.2)
+        runner.request_stop()
+        thread.join(60)
+        assert not thread.is_alive()
+        return runner, banner
+
+    r_on, b_on = _run("on", incidents=True)
+    r_off, b_off = _run("off", incidents=False)
+    # the ON run captured at least one bundle; the OFF run has no plane
+    assert r_on._incidents is not None and r_on._incidents.count() >= 1
+    assert r_off._incidents is None
+    assert resolve_incidents_dir(str(tmp_path / "off")) is None
+    # ...and the verdict sidecars are bit-identical modulo wall-clock
+    on, off = _canon(b_on["verdicts"]), _canon(b_off["verdicts"])
+    assert on == off and on
